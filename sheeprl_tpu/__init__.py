@@ -1,0 +1,59 @@
+"""sheeprl-tpu: a TPU-native reinforcement-learning framework.
+
+Built from scratch for JAX/XLA/Pallas/pjit with the capability surface of
+Eclectic-Sheep/sheeprl (the reference implementation analyzed in SURVEY.md):
+A2C, PPO (+recurrent, +decoupled), SAC (+AE, +decoupled), DroQ,
+Dreamer V1/V2/V3 and Plan2Explore, over Gymnasium environments, with
+host-side replay buffers feeding jit-compiled SPMD train steps on a
+``jax.sharding.Mesh``.
+
+Importing the package registers every available algorithm (the reference does
+the same import-side-effect registration, sheeprl/__init__.py:18-47).
+"""
+
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+
+def register_all_algorithms() -> None:
+    """Import every algorithm module for its registration side effect."""
+    import importlib
+
+    for mod in (
+        "sheeprl_tpu.algos.ppo.ppo",
+        "sheeprl_tpu.algos.ppo.ppo_decoupled",
+        "sheeprl_tpu.algos.ppo.evaluate",
+        "sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent",
+        "sheeprl_tpu.algos.ppo_recurrent.evaluate",
+        "sheeprl_tpu.algos.a2c.a2c",
+        "sheeprl_tpu.algos.a2c.evaluate",
+        "sheeprl_tpu.algos.sac.sac",
+        "sheeprl_tpu.algos.sac.sac_decoupled",
+        "sheeprl_tpu.algos.sac.evaluate",
+        "sheeprl_tpu.algos.sac_ae.sac_ae",
+        "sheeprl_tpu.algos.sac_ae.evaluate",
+        "sheeprl_tpu.algos.droq.droq",
+        "sheeprl_tpu.algos.droq.evaluate",
+        "sheeprl_tpu.algos.dreamer_v1.dreamer_v1",
+        "sheeprl_tpu.algos.dreamer_v1.evaluate",
+        "sheeprl_tpu.algos.dreamer_v2.dreamer_v2",
+        "sheeprl_tpu.algos.dreamer_v2.evaluate",
+        "sheeprl_tpu.algos.dreamer_v3.dreamer_v3",
+        "sheeprl_tpu.algos.dreamer_v3.evaluate",
+        "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_exploration",
+        "sheeprl_tpu.algos.p2e_dv1.p2e_dv1_finetuning",
+        "sheeprl_tpu.algos.p2e_dv1.evaluate",
+        "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_exploration",
+        "sheeprl_tpu.algos.p2e_dv2.p2e_dv2_finetuning",
+        "sheeprl_tpu.algos.p2e_dv2.evaluate",
+        "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration",
+        "sheeprl_tpu.algos.p2e_dv3.p2e_dv3_finetuning",
+        "sheeprl_tpu.algos.p2e_dv3.evaluate",
+    ):
+        try:
+            importlib.import_module(mod)
+        except ModuleNotFoundError as e:
+            # Only tolerate modules not built yet; surface real import errors.
+            if "sheeprl_tpu" not in str(e):
+                raise

@@ -1,0 +1,69 @@
+"""Deterministic dummy environments for the test harness.
+
+Parity with the reference's dummy envs (reference: sheeprl/envs/dummy.py:8-108):
+Dict observations (an ``rgb`` image + a ``state`` vector), fixed-length
+episodes, and discrete / multi-discrete / continuous action variants.  Images
+are channel-last ``(H, W, C)`` (the TPU-native layout used framework-wide).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class _DummyEnv(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+    render_mode = "rgb_array"
+
+    def __init__(self, image_size: Tuple[int, int, int] = (64, 64, 3), episode_len: int = 128):
+        self._image_size = image_size
+        self._episode_len = episode_len
+        self._step = 0
+        self.observation_space = spaces.Dict(
+            {
+                "rgb": spaces.Box(0, 255, image_size, np.uint8),
+                "state": spaces.Box(-np.inf, np.inf, (4,), np.float32),
+            }
+        )
+        self.reward_range = (0.0, 1.0)
+
+    def _obs(self) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": np.full(self._image_size, self._step % 256, dtype=np.uint8),
+            "state": np.full((4,), self._step, dtype=np.float32),
+        }
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        super().reset(seed=seed)
+        self._step = 0
+        return self._obs(), {}
+
+    def step(self, action: Any):
+        self._step += 1
+        done = self._step >= self._episode_len
+        return self._obs(), 1.0, done, False, {}
+
+    def render(self) -> np.ndarray:
+        return self._obs()["rgb"]
+
+
+class DiscreteDummyEnv(_DummyEnv):
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.action_space = spaces.Discrete(4)
+
+
+class MultiDiscreteDummyEnv(_DummyEnv):
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.action_space = spaces.MultiDiscrete([4, 3])
+
+
+class ContinuousDummyEnv(_DummyEnv):
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.action_space = spaces.Box(-1.0, 1.0, (2,), np.float32)

@@ -1,0 +1,295 @@
+"""Generic environment wrappers.
+
+Re-implementations (gymnasium 1.x API) of the reference's wrapper set
+(reference: sheeprl/envs/wrappers.py:13-342).  One intentional difference for
+the TPU build: image observations are channel-LAST ``(H, W, C)`` throughout —
+the layout XLA's TPU convolutions prefer — where the reference standardizes
+on torch's ``(C, H, W)``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Sequence, SupportsFloat, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+
+class MaskVelocityWrapper(gym.ObservationWrapper):
+    """Zero out velocity components of classic-control observations, turning
+    them into partially-observable tasks (reference: envs/wrappers.py:13-45)."""
+
+    velocity_indices: Dict[str, np.ndarray] = {
+        "CartPole-v0": np.array([1, 3]),
+        "CartPole-v1": np.array([1, 3]),
+        "MountainCar-v0": np.array([1]),
+        "MountainCarContinuous-v0": np.array([1]),
+        "Pendulum-v1": np.array([2]),
+        "LunarLander-v2": np.array([2, 3, 5]),
+        "LunarLander-v3": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v2": np.array([2, 3, 5]),
+        "LunarLanderContinuous-v3": np.array([2, 3, 5]),
+    }
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        env_id = env.spec.id if env.spec is not None else ""
+        if env_id not in self.velocity_indices:
+            raise NotImplementedError(f"Velocity masking not implemented for {env_id}")
+        self.mask = np.ones(env.observation_space.shape, dtype=np.float32)
+        self.mask[self.velocity_indices[env_id]] = 0.0
+
+    def observation(self, observation: np.ndarray) -> np.ndarray:
+        return observation * self.mask
+
+
+class ActionRepeat(gym.Wrapper):
+    """Repeat each action ``amount`` times, summing rewards
+    (reference: envs/wrappers.py:48-71)."""
+
+    def __init__(self, env: gym.Env, amount: int):
+        super().__init__(env)
+        if amount <= 0:
+            raise ValueError(f"action_repeat must be positive, got {amount}")
+        self._amount = int(amount)
+
+    @property
+    def action_repeat(self) -> int:
+        return self._amount
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        total_reward = 0.0
+        obs, terminated, truncated, info = None, False, False, {}
+        for _ in range(self._amount):
+            obs, reward, terminated, truncated, info = self.env.step(action)
+            total_reward += float(reward)
+            if terminated or truncated:
+                break
+        return obs, total_reward, terminated, truncated, info
+
+
+class RestartOnException(gym.Wrapper):
+    """Recreate a crashed environment instead of killing training
+    (reference: envs/wrappers.py:74-123).  At most ``max_restarts`` within
+    ``window`` seconds; beyond that the exception propagates.  After a
+    restart, ``info["restart_on_exception"]`` is set so the train loop can
+    patch its replay buffer (as DreamerV3 does,
+    reference: sheeprl/algos/dreamer_v3/dreamer_v3.py:595-608).
+    """
+
+    def __init__(self, env_fn: Callable[[], gym.Env], max_restarts: int = 5, window: float = 60.0):
+        self._env_fn = env_fn
+        self._max_restarts = max_restarts
+        self._window = window
+        self._restart_times: deque = deque()
+        super().__init__(env_fn())
+
+    def _restart(self) -> None:
+        now = time.monotonic()
+        while self._restart_times and now - self._restart_times[0] > self._window:
+            self._restart_times.popleft()
+        if len(self._restart_times) >= self._max_restarts:
+            raise RuntimeError(
+                f"Environment crashed {len(self._restart_times)} times within "
+                f"{self._window}s; giving up"
+            )
+        self._restart_times.append(now)
+        try:
+            self.env.close()
+        except Exception:
+            pass
+        self.env = self._env_fn()
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        try:
+            return self.env.step(action)
+        except Exception:
+            self._restart()
+            obs, info = self.env.reset()
+            info = dict(info)
+            info["restart_on_exception"] = True
+            return obs, 0.0, False, True, info
+
+    def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
+        try:
+            return self.env.reset(**kwargs)
+        except Exception:
+            self._restart()
+            obs, info = self.env.reset(**kwargs)
+            info = dict(info)
+            info["restart_on_exception"] = True
+            return obs, info
+
+
+class FrameStack(gym.Wrapper):
+    """Stack the last ``num_stack`` frames of every image key of a Dict
+    observation space, with optional temporal ``dilation``
+    (reference: envs/wrappers.py:126-182).
+
+    Stacking adds a leading axis: ``(H, W, C)`` → ``(num_stack, H, W, C)``.
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, cnn_keys: Sequence[str], dilation: int = 1):
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"num_stack must be positive, got {num_stack}")
+        if not isinstance(env.observation_space, spaces.Dict):
+            raise RuntimeError("FrameStack requires a Dict observation space")
+        self._num_stack = int(num_stack)
+        self._dilation = int(dilation)
+        self._cnn_keys = [
+            k for k in cnn_keys if len(env.observation_space[k].shape) == 3
+        ]
+        if not self._cnn_keys:
+            raise RuntimeError(f"No image keys to stack among {list(cnn_keys)}")
+        self._frames: Dict[str, deque] = {
+            k: deque(maxlen=num_stack * dilation) for k in self._cnn_keys
+        }
+        new_spaces = dict(env.observation_space.spaces)
+        for k in self._cnn_keys:
+            sp = env.observation_space[k]
+            new_spaces[k] = spaces.Box(
+                np.repeat(sp.low[None], num_stack, axis=0),
+                np.repeat(sp.high[None], num_stack, axis=0),
+                (num_stack, *sp.shape),
+                sp.dtype,
+            )
+        self.observation_space = spaces.Dict(new_spaces)
+
+    def _stacked(self, key: str) -> np.ndarray:
+        frames = list(self._frames[key])[:: -self._dilation][::-1]
+        return np.stack(frames, axis=0)
+
+    def _observation(self, obs: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(obs)
+        for k in self._cnn_keys:
+            out[k] = self._stacked(k)
+        return out
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        for k in self._cnn_keys:
+            self._frames[k].append(obs[k])
+        return self._observation(obs), reward, terminated, truncated, info
+
+    def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
+        obs, info = self.env.reset(**kwargs)
+        for k in self._cnn_keys:
+            for _ in range(self._num_stack * self._dilation):
+                self._frames[k].append(obs[k])
+        return self._observation(obs), info
+
+
+class RewardAsObservationWrapper(gym.Wrapper):
+    """Expose the last reward as an extra observation key
+    (reference: envs/wrappers.py:185-241)."""
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        reward_space = spaces.Box(-np.inf, np.inf, (1,), np.float32)
+        if isinstance(env.observation_space, spaces.Dict):
+            new_spaces = {**env.observation_space.spaces, "reward": reward_space}
+        else:
+            new_spaces = {"obs": env.observation_space, "reward": reward_space}
+        self.observation_space = spaces.Dict(new_spaces)
+
+    def _wrap(self, obs: Any, reward: float) -> Dict[str, Any]:
+        r = np.array([reward], dtype=np.float32)
+        if isinstance(obs, dict):
+            return {**obs, "reward": r}
+        return {"obs": obs, "reward": r}
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._wrap(obs, float(reward)), reward, terminated, truncated, info
+
+    def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
+        obs, info = self.env.reset(**kwargs)
+        return self._wrap(obs, 0.0), info
+
+
+class ActionsAsObservationWrapper(gym.Wrapper):
+    """Expose the last ``num_stack`` actions as an observation key
+    (reference: envs/wrappers.py:258-342).
+
+    Discrete actions are one-hot encoded; multi-discrete become concatenated
+    one-hots; continuous are used as-is.  ``noop`` defines the action used to
+    fill the stack on reset.  ``dilation`` skips intermediate actions.
+    """
+
+    def __init__(self, env: gym.Env, num_stack: int, noop: Any, dilation: int = 1):
+        super().__init__(env)
+        if num_stack <= 0:
+            raise ValueError(f"num_stack must be positive, got {num_stack}")
+        if dilation <= 0:
+            raise ValueError(f"dilation must be positive, got {dilation}")
+        self._num_stack = num_stack
+        self._dilation = dilation
+        act_space = env.action_space
+        if isinstance(act_space, spaces.Discrete):
+            self._per_action = int(act_space.n)
+        elif isinstance(act_space, spaces.MultiDiscrete):
+            self._per_action = int(np.sum(act_space.nvec))
+        elif isinstance(act_space, spaces.Box):
+            self._per_action = int(np.prod(act_space.shape))
+        else:
+            raise RuntimeError(f"Unsupported action space {type(act_space)}")
+        self._noop = noop
+        self._actions: deque = deque(maxlen=num_stack * dilation)
+        action_obs_space = spaces.Box(-np.inf, np.inf, (num_stack * self._per_action,), np.float32)
+        if isinstance(env.observation_space, spaces.Dict):
+            new_spaces = {**env.observation_space.spaces, "action_stack": action_obs_space}
+        else:
+            new_spaces = {"obs": env.observation_space, "action_stack": action_obs_space}
+        self.observation_space = spaces.Dict(new_spaces)
+
+    def _encode(self, action: Any) -> np.ndarray:
+        act_space = self.env.action_space
+        if isinstance(act_space, spaces.Discrete):
+            out = np.zeros(self._per_action, dtype=np.float32)
+            out[int(np.asarray(action).reshape(()))] = 1.0
+            return out
+        if isinstance(act_space, spaces.MultiDiscrete):
+            parts = []
+            for a, n in zip(np.asarray(action).flatten(), act_space.nvec):
+                oh = np.zeros(int(n), dtype=np.float32)
+                oh[int(a)] = 1.0
+                parts.append(oh)
+            return np.concatenate(parts)
+        return np.asarray(action, dtype=np.float32).flatten()
+
+    def _obs_with_actions(self, obs: Any) -> Dict[str, Any]:
+        actions = list(self._actions)[:: -self._dilation][::-1]
+        stack = np.concatenate([self._encode(a) for a in actions])
+        if isinstance(obs, dict):
+            return {**obs, "action_stack": stack}
+        return {"obs": obs, "action_stack": stack}
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        self._actions.append(action)
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        return self._obs_with_actions(obs), reward, terminated, truncated, info
+
+    def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
+        obs, info = self.env.reset(**kwargs)
+        for _ in range(self._num_stack * self._dilation):
+            self._actions.append(self._noop)
+        return self._obs_with_actions(obs), info
+
+
+class GrayscaleRenderWrapper(gym.Wrapper):
+    """Make ``render()`` return 3-channel frames for video capture even when
+    observations are grayscale (reference: envs/wrappers.py:244-255)."""
+
+    def render(self) -> Any:
+        frame = self.env.render()
+        if frame is not None:
+            frame = np.asarray(frame)
+            if frame.ndim == 2:
+                frame = np.repeat(frame[..., None], 3, axis=-1)
+            elif frame.ndim == 3 and frame.shape[-1] == 1:
+                frame = np.repeat(frame, 3, axis=-1)
+        return frame

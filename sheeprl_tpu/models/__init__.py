@@ -1,0 +1,1 @@
+"""sheeprl_tpu.models."""

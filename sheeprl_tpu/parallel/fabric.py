@@ -1,0 +1,313 @@
+"""Single-controller SPMD runtime over a ``jax.sharding.Mesh``.
+
+This is the TPU-native replacement for the reference's Lightning Fabric layer
+(reference: sheeprl/configs/fabric/default.yaml and the ``fabric.*`` calls all
+over sheeprl/algos/*): device selection, the device mesh, precision policy,
+checkpointing callbacks, and host collectives.
+
+Design differences from the reference, on purpose (SURVEY.md §2.2/§7):
+
+* The reference spawns one Python process per device and synchronizes with
+  NCCL/Gloo DDP all-reduce.  Here ONE controller process drives all local
+  devices: parameters are *replicated* over the mesh, batches are *sharded*
+  over the ``data`` axis, and a jitted train step whose loss is a mean over
+  the batch makes XLA insert the gradient all-reduce over ICI automatically
+  (GSPMD).  There is no process-group bookkeeping to port.
+* Multi-host (DCN) uses ``jax.distributed.initialize`` + the same mesh
+  spanning all hosts; host-side object exchange (log dirs, configs) rides
+  :meth:`broadcast_object` built on ``multihost_utils``.
+* "world_size" therefore means the total number of devices in the mesh (the
+  data-parallel degree), and "global_rank" the process index — which is what
+  the reference uses each for (batch splitting vs. rank-0-only logging).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class Precision:
+    """Maps the reference's Lightning precision strings to JAX dtype policy.
+
+    ``param_dtype`` is the dtype parameters are stored in, ``compute_dtype``
+    the dtype activations are computed in (models cast inputs / params at
+    call sites).  On TPU, bf16 compute hits the MXU fast path while fp32
+    params keep optimizer numerics stable.
+    """
+
+    name: str
+    param_dtype: Any
+    compute_dtype: Any
+
+    @staticmethod
+    def from_string(precision: str) -> "Precision":
+        table = {
+            "32-true": (jnp.float32, jnp.float32),
+            "bf16-mixed": (jnp.float32, jnp.bfloat16),
+            "bf16-true": (jnp.bfloat16, jnp.bfloat16),
+        }
+        if precision not in table:
+            raise ValueError(f"Unknown precision '{precision}'; choose from {list(table)}")
+        param, compute = table[precision]
+        return Precision(precision, param, compute)
+
+
+def _resolve_accelerator(accelerator: str) -> str:
+    if accelerator in ("auto", None):
+        platforms = {d.platform for d in jax.devices()}
+        for pref in ("tpu", "gpu", "axon"):
+            if pref in platforms:
+                return pref
+        return "cpu"
+    return {"tpu": "tpu", "cuda": "gpu", "gpu": "gpu", "cpu": "cpu", "axon": "axon"}.get(
+        accelerator, accelerator
+    )
+
+
+class Fabric:
+    """Runtime facade handed to every algorithm ``main(fabric, cfg)``."""
+
+    def __init__(
+        self,
+        devices: Union[int, str] = 1,
+        num_nodes: int = 1,
+        strategy: str = "auto",
+        accelerator: str = "auto",
+        precision: str = "32-true",
+        callbacks: Optional[Dict[str, Any]] = None,
+        mesh_shape: Optional[Dict[str, int]] = None,
+    ):
+        self.strategy = strategy
+        self.precision = Precision.from_string(precision)
+        self.callbacks: List[Any] = []
+        self._callback_cfg = callbacks or {}
+
+        platform = _resolve_accelerator(accelerator)
+        try:
+            all_devices = jax.devices(platform)
+        except RuntimeError:
+            all_devices = jax.devices()
+        if devices in ("auto", -1, "-1", None):
+            n = len(all_devices)
+        else:
+            n = int(devices)
+        if n > len(all_devices):
+            raise ValueError(
+                f"Requested {n} devices but only {len(all_devices)} {platform} devices exist"
+            )
+        self.devices: List[Any] = all_devices[:n]
+        self.accelerator = platform
+
+        # Mesh: default a single "data" axis (DDP semantics).  mesh_shape may
+        # request extra axes, e.g. {"data": -1, "model": 2} for TP sharding.
+        if mesh_shape:
+            names = tuple(mesh_shape.keys())
+            sizes = list(mesh_shape.values())
+            minus = [i for i, s in enumerate(sizes) if s in (-1, None)]
+            fixed = int(np.prod([s for s in sizes if s not in (-1, None)])) or 1
+            if minus:
+                sizes[minus[0]] = n // fixed
+            dev_array = np.asarray(self.devices).reshape(tuple(int(s) for s in sizes))
+            self.mesh = Mesh(dev_array, names)
+        else:
+            self.mesh = Mesh(np.asarray(self.devices), ("data",))
+        self.data_axis = self.mesh.axis_names[0]
+
+    # -- topology ---------------------------------------------------------
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    @property
+    def global_rank(self) -> int:
+        return jax.process_index()
+
+    @property
+    def num_processes(self) -> int:
+        return jax.process_count()
+
+    @property
+    def is_global_zero(self) -> bool:
+        return self.global_rank == 0
+
+    @property
+    def device(self) -> Any:
+        return self.devices[0]
+
+    # -- sharding helpers --------------------------------------------------
+    def sharding(self, *spec: Any) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    @property
+    def batch_sharded(self) -> NamedSharding:
+        """Shard the leading axis over the data axis of the mesh."""
+        return NamedSharding(self.mesh, P(self.data_axis))
+
+    def shard_batch(self, tree: Any, axis: int = 0) -> Any:
+        """Place a host batch on device, split along ``axis`` over the mesh."""
+
+        def put(x: Any) -> Any:
+            spec = [None] * np.ndim(x)
+            if np.ndim(x) > axis:
+                spec[axis] = self.data_axis
+            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+
+        return jax.tree.map(put, tree)
+
+    def replicate(self, tree: Any) -> Any:
+        """Replicate a pytree (params/opt state) across the mesh."""
+        return jax.device_put(tree, self.replicated)
+
+    def setup_module(self, tree: Any) -> Any:  # reference-API parity alias
+        return self.replicate(tree)
+
+    def jit(
+        self,
+        fn: Callable,
+        in_shardings: Any = None,
+        out_shardings: Any = None,
+        donate_argnums: Tuple[int, ...] = (),
+        static_argnums: Tuple[int, ...] = (),
+    ) -> Callable:
+        """``jax.jit`` bound to this fabric's mesh."""
+        return jax.jit(
+            fn,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            donate_argnums=donate_argnums,
+            static_argnums=static_argnums,
+        )
+
+    # -- host collectives --------------------------------------------------
+    def all_gather_object(self, obj: Any) -> List[Any]:
+        if self.num_processes == 1:
+            return [obj]
+        from jax.experimental import multihost_utils
+
+        payload = _pickle_to_u8(obj)
+        # process_allgather needs equal shapes: agree on max length, pad.
+        lengths = multihost_utils.process_allgather(
+            np.asarray([payload.size], dtype=np.int64)
+        ).reshape(-1)
+        max_len = int(lengths.max())
+        padded = np.zeros(max_len, dtype=np.uint8)
+        padded[: payload.size] = payload
+        gathered = multihost_utils.process_allgather(padded)
+        return [
+            _u8_to_obj(np.asarray(row[: int(n)]))
+            for row, n in zip(np.atleast_2d(gathered), lengths)
+        ]
+
+    def broadcast_object(self, obj: Any, src: int = 0) -> Any:
+        if self.num_processes == 1:
+            return obj
+        from jax.experimental import multihost_utils
+
+        payload = _pickle_to_u8(obj) if self.global_rank == src else None
+        length = multihost_utils.broadcast_one_to_all(
+            np.asarray([0 if payload is None else payload.size], dtype=np.int64)
+        )[0]
+        buf = payload if payload is not None else np.zeros(int(length), dtype=np.uint8)
+        out = multihost_utils.broadcast_one_to_all(buf)
+        return _u8_to_obj(np.asarray(out))
+
+    def barrier(self) -> None:
+        if self.num_processes > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
+
+    # -- checkpoint callbacks ---------------------------------------------
+    def register_callback(self, callback: Any) -> None:
+        self.callbacks.append(callback)
+
+    def call(self, hook: str, **kwargs: Any) -> None:
+        for cb in self.callbacks:
+            fn = getattr(cb, hook, None)
+            if fn is not None:
+                fn(fabric=self, **kwargs)
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path: Union[str, os.PathLike], state: Dict[str, Any]) -> None:
+        from sheeprl_tpu.utils.checkpoint import save_checkpoint
+
+        if self.is_global_zero:
+            save_checkpoint(path, state)
+        self.barrier()
+
+    def load(self, path: Union[str, os.PathLike]) -> Dict[str, Any]:
+        from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+        return load_checkpoint(path)
+
+    # -- misc ---------------------------------------------------------------
+    def print(self, *args: Any, **kwargs: Any) -> None:
+        if self.is_global_zero:
+            print(*args, **kwargs)
+
+    def seed_everything(self, seed: int) -> jax.Array:
+        np.random.seed(seed)
+        import random
+
+        random.seed(seed)
+        return jax.random.PRNGKey(seed)
+
+
+def _pickle_to_u8(obj: Any) -> np.ndarray:
+    import pickle
+
+    return np.frombuffer(pickle.dumps(obj), dtype=np.uint8).copy()
+
+
+def _u8_to_obj(arr: np.ndarray) -> Any:
+    import pickle
+
+    return pickle.loads(arr.tobytes())
+
+
+def build_fabric(cfg: Any) -> Fabric:
+    """Instantiate the runtime from ``cfg.fabric`` (+ register callbacks)."""
+    fab_cfg = cfg.fabric
+    fabric = Fabric(
+        devices=fab_cfg.get("devices", 1),
+        num_nodes=fab_cfg.get("num_nodes", 1),
+        strategy=fab_cfg.get("strategy", "auto"),
+        accelerator=fab_cfg.get("accelerator", "auto"),
+        precision=fab_cfg.get("precision", "32-true"),
+        callbacks=fab_cfg.get("callbacks", {}),
+        mesh_shape=fab_cfg.get("mesh_shape", None),
+    )
+    cb_cfg = fab_cfg.get("callbacks", {}) or {}
+    if "checkpoint" in cb_cfg:
+        from sheeprl_tpu.utils.callback import CheckpointCallback
+
+        fabric.register_callback(CheckpointCallback(keep_last=cb_cfg["checkpoint"].get("keep_last", 5)))
+    return fabric
+
+
+def get_single_device_fabric(fabric: Fabric) -> Fabric:
+    """A fabric pinned to one device, for inference-only "player" models
+    (reference: sheeprl/utils/fabric.py:8-35)."""
+    single = Fabric.__new__(Fabric)
+    single.strategy = fabric.strategy
+    single.precision = fabric.precision
+    single.callbacks = []
+    single._callback_cfg = {}
+    single.devices = [fabric.device]
+    single.accelerator = fabric.accelerator
+    single.mesh = Mesh(np.asarray([fabric.device]), ("data",))
+    single.data_axis = "data"
+    return single

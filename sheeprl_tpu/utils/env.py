@@ -1,0 +1,200 @@
+"""Environment factory.
+
+Parity with the reference factory (reference: sheeprl/utils/env.py:26-231):
+``make_env(cfg, seed, rank, ...)`` returns a thunk producing a fully-wrapped
+``gym.Env`` whose observation space is ALWAYS a ``gym.spaces.Dict``, with the
+wrapper pipeline: suite wrapper → ActionRepeat → velocity masking →
+image normalization (resize / grayscale) → FrameStack → actions-as-obs →
+reward-as-obs → reward clipping → TimeLimit → RecordEpisodeStatistics →
+RecordVideo (rank 0, env 0 only).
+
+TPU-first convention: images are channel-last ``(H, W, C)`` uint8 (XLA TPU
+convolutions are natively NHWC); the reference uses torch's ``(C, H, W)``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    MaskVelocityWrapper,
+    RewardAsObservationWrapper,
+)
+
+DUMMY_ENVS = {
+    "discrete_dummy": DiscreteDummyEnv,
+    "multidiscrete_dummy": MultiDiscreteDummyEnv,
+    "continuous_dummy": ContinuousDummyEnv,
+}
+
+
+def get_dummy_env(env_id: str) -> gym.Env:
+    if env_id not in DUMMY_ENVS:
+        raise ValueError(f"Unknown dummy env '{env_id}'; options: {list(DUMMY_ENVS)}")
+    return DUMMY_ENVS[env_id]()
+
+
+def _make_base_env(cfg: Any, seed: Optional[int], render_mode: str) -> gym.Env:
+    env_id = cfg.env.id
+    if env_id in DUMMY_ENVS:
+        return get_dummy_env(env_id)
+    wrapper_cfg = cfg.env.get("wrapper") or {}
+    if not isinstance(wrapper_cfg, dict):  # "???" placeholder or suite name
+        wrapper_cfg = {"kind": str(wrapper_cfg)} if wrapper_cfg != "???" else {}
+    kind = wrapper_cfg.get("kind", "gym")
+    if kind == "gym":
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return gym.make(env_id, render_mode=render_mode, **kwargs)
+    if kind == "atari":
+        from sheeprl_tpu.envs.atari import make_atari_env
+
+        return make_atari_env(env_id, cfg, render_mode=render_mode)
+    if kind == "dmc":
+        from sheeprl_tpu.envs.dmc import DMCWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return DMCWrapper(env_id, seed=seed, **kwargs)
+    if kind == "crafter":
+        from sheeprl_tpu.envs.crafter import CrafterWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return CrafterWrapper(env_id, **kwargs)
+    raise ValueError(f"Unknown env wrapper kind '{kind}'")
+
+
+class _DictObs(gym.ObservationWrapper):
+    """Normalize any observation space into a Dict: vectors → 'state',
+    images → 'rgb' (reference behavior: sheeprl/utils/env.py:117-159)."""
+
+    def __init__(self, env: gym.Env):
+        super().__init__(env)
+        obs_space = env.observation_space
+        if isinstance(obs_space, spaces.Dict):
+            self._key_map = None
+            self.observation_space = obs_space
+        else:
+            key = "rgb" if len(obs_space.shape or ()) == 3 else "state"
+            self._key_map = key
+            self.observation_space = spaces.Dict({key: obs_space})
+
+    def observation(self, observation: Any) -> Dict[str, Any]:
+        if self._key_map is None:
+            return observation
+        return {self._key_map: observation}
+
+
+class _ImageTransform(gym.ObservationWrapper):
+    """Resize / grayscale every cnn key to ``(screen, screen, C)`` uint8
+    (reference: sheeprl/utils/env.py:161-196, rewritten channel-last)."""
+
+    def __init__(self, env: gym.Env, cnn_keys: list, screen_size: int, grayscale: bool):
+        super().__init__(env)
+        import cv2  # local import: heavy
+
+        self._cv2 = cv2
+        self._cnn_keys = cnn_keys
+        self._screen = screen_size
+        self._gray = grayscale
+        new_spaces = dict(env.observation_space.spaces)
+        channels = 1 if grayscale else 3
+        for k in cnn_keys:
+            new_spaces[k] = spaces.Box(0, 255, (screen_size, screen_size, channels), np.uint8)
+        self.observation_space = spaces.Dict(new_spaces)
+
+    def _transform(self, img: np.ndarray) -> np.ndarray:
+        cv2 = self._cv2
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[..., None]
+        if img.shape[0] in (1, 3) and img.shape[-1] not in (1, 3):
+            img = np.transpose(img, (1, 2, 0))  # CHW → HWC
+        if img.shape[:2] != (self._screen, self._screen):
+            img = cv2.resize(img, (self._screen, self._screen), interpolation=cv2.INTER_AREA)
+            if img.ndim == 2:
+                img = img[..., None]
+        if self._gray and img.shape[-1] == 3:
+            img = cv2.cvtColor(img, cv2.COLOR_RGB2GRAY)[..., None]
+        elif not self._gray and img.shape[-1] == 1:
+            img = np.repeat(img, 3, axis=-1)
+        return img.astype(np.uint8)
+
+    def observation(self, observation: Dict[str, Any]) -> Dict[str, Any]:
+        out = dict(observation)
+        for k in self._cnn_keys:
+            out[k] = self._transform(observation[k])
+        return out
+
+
+def make_env(
+    cfg: Any,
+    seed: Optional[int],
+    rank: int = 0,
+    run_name: Optional[str] = None,
+    prefix: str = "",
+    vector_env_idx: int = 0,
+) -> Callable[[], gym.Env]:
+    """Build a thunk creating one fully-wrapped environment instance."""
+
+    def thunk() -> gym.Env:
+        capture = bool(cfg.env.capture_video) and rank == 0 and vector_env_idx == 0 and run_name is not None
+        render_mode = "rgb_array" if capture else cfg.env.get("render_mode", "rgb_array")
+        env = _make_base_env(cfg, seed, render_mode)
+
+        if cfg.env.action_repeat > 1:
+            env = ActionRepeat(env, cfg.env.action_repeat)
+        if cfg.env.get("mask_velocities", False):
+            env = MaskVelocityWrapper(env)
+
+        env = _DictObs(env)
+
+        cnn_keys = [
+            k
+            for k in env.observation_space.spaces
+            if len(env.observation_space[k].shape) in (2, 3)
+        ]
+        if cnn_keys:
+            env = _ImageTransform(env, cnn_keys, cfg.env.screen_size, cfg.env.grayscale)
+        if cfg.env.frame_stack > 1 and cnn_keys:
+            env = FrameStack(env, cfg.env.frame_stack, cnn_keys, cfg.env.frame_stack_dilation)
+
+        aao = cfg.env.get("actions_as_observation", {})
+        if aao and aao.get("num_stack", -1) > 0:
+            env = ActionsAsObservationWrapper(env, aao["num_stack"], aao["noop"], aao.get("dilation", 1))
+        if cfg.env.reward_as_observation:
+            env = RewardAsObservationWrapper(env)
+        if cfg.env.clip_rewards:
+            env = gym.wrappers.TransformReward(env, lambda r: float(np.tanh(r)))
+        if cfg.env.max_episode_steps is not None and cfg.env.max_episode_steps > 0:
+            env = gym.wrappers.TimeLimit(env, cfg.env.max_episode_steps)
+        env = gym.wrappers.RecordEpisodeStatistics(env)
+        if capture:
+            import os
+
+            video_dir = os.path.join(run_name, prefix + "_videos" if prefix else "videos")
+            env = gym.wrappers.RecordVideo(env, video_dir, disable_logger=True)
+
+        if seed is not None:
+            env.reset(seed=seed + rank * cfg.env.num_envs + vector_env_idx)
+            env.action_space.seed(seed + rank * cfg.env.num_envs + vector_env_idx)
+        return env
+
+    return thunk
+
+
+def vectorize(cfg: Any, thunks: list) -> gym.vector.VectorEnv:
+    """Vectorize with SAME_STEP autoreset so rollout loops observe the
+    pre-1.0 gymnasium semantics the algorithms are written against
+    (final observations surfaced via ``info["final_obs"]``)."""
+    from gymnasium.vector import AutoresetMode
+
+    if cfg.env.sync_env:
+        return gym.vector.SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)

@@ -1,0 +1,50 @@
+"""Named wall-clock timers.
+
+Same role as the reference's ``timer`` ContextDecorator
+(reference: sheeprl/utils/timer.py:16-83): train loops wrap the env-interaction
+and train phases, and at log time derived steps-per-second throughputs are
+computed then timers reset.  JAX note: because dispatch is asynchronous, the
+train-phase wrapper calls ``block_until_ready`` on an optional sentinel array
+so measured time includes device execution.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import ContextDecorator
+from typing import Any, ClassVar, Dict
+
+
+class timer(ContextDecorator):
+    disabled: ClassVar[bool] = False
+    timers: ClassVar[Dict[str, float]] = {}
+    _counts: ClassVar[Dict[str, int]] = {}
+
+    def __init__(self, name: str, mode: str = "sum"):
+        self.name = name
+        self.mode = mode
+
+    def __enter__(self) -> "timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        if not timer.disabled:
+            elapsed = time.perf_counter() - self._start
+            if self.mode == "sum":
+                timer.timers[self.name] = timer.timers.get(self.name, 0.0) + elapsed
+            else:  # mean
+                timer.timers[self.name] = timer.timers.get(self.name, 0.0) + elapsed
+                timer._counts[self.name] = timer._counts.get(self.name, 0) + 1
+        return False
+
+    @classmethod
+    def to_dict(cls, reset: bool = True) -> Dict[str, float]:
+        out = {}
+        for k, v in cls.timers.items():
+            n = cls._counts.get(k)
+            out[k] = v / n if n else v
+        if reset:
+            cls.timers = {}
+            cls._counts = {}
+        return out

@@ -1,0 +1,246 @@
+"""Shared math / control utilities (JAX-first).
+
+Capability parity with the reference's grab-bag utils
+(reference: sheeprl/utils/utils.py:63-313) — GAE, symlog/symexp, two-hot
+encoding, normalization, polynomial decay, the replay-ratio governor — but
+every array op is a pure jittable JAX function shaped for ``lax.scan`` /
+XLA fusion instead of per-step Python loops.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+
+# --------------------------------------------------------------------------
+# returns / advantages
+# --------------------------------------------------------------------------
+
+def gae(
+    rewards: jax.Array,
+    values: jax.Array,
+    dones: jax.Array,
+    next_value: jax.Array,
+    gamma: float,
+    lmbda: float,
+) -> Tuple[jax.Array, jax.Array]:
+    """Generalized advantage estimation over a ``(T, B, ...)`` rollout.
+
+    The reference computes this with a reversed Python loop
+    (reference: sheeprl/utils/utils.py:63-100); here it is a single reversed
+    ``lax.scan`` so the whole advantage computation compiles into the rollout
+    post-processing graph.
+
+    ``dones[t]`` flags whether the episode ended *at* step t (so state t+1 was
+    a reset).  Returns ``(returns, advantages)`` with the same shape as
+    ``rewards``.
+    """
+    not_done = 1.0 - dones.astype(values.dtype)
+
+    def step(carry, xs):
+        lastgaelam, next_val = carry
+        reward, value, nd = xs
+        delta = reward + gamma * next_val * nd - value
+        lastgaelam = delta + gamma * lmbda * nd * lastgaelam
+        return (lastgaelam, value), lastgaelam
+
+    init = (jnp.zeros_like(next_value), next_value)
+    _, advantages = jax.lax.scan(step, init, (rewards, values, not_done), reverse=True)
+    returns = advantages + values
+    return returns, advantages
+
+
+def lambda_returns(
+    rewards: jax.Array,
+    values: jax.Array,
+    continues: jax.Array,
+    lmbda: float,
+) -> jax.Array:
+    """TD(λ) returns for imagined trajectories (Dreamer-style).
+
+    ``rewards, values, continues`` are ``(T, B, ...)``; ``continues`` already
+    folds in the discount factor (γ·(1-done)).  The recursion
+    ``R_t = r_t + c_t · ((1-λ)·v_{t+1} + λ·R_{t+1})`` runs as a reversed
+    ``lax.scan`` (reference equivalent: sheeprl/algos/dreamer_v3/utils.py:66-77).
+    The last step bootstraps from ``values[-1]``.
+    """
+
+    def step(next_ret, xs):
+        reward, cont, next_value = xs
+        ret = reward + cont * ((1 - lmbda) * next_value + lmbda * next_ret)
+        return ret, ret
+
+    next_values = jnp.concatenate([values[1:], values[-1:]], axis=0)
+    _, rets = jax.lax.scan(step, values[-1], (rewards, continues, next_values), reverse=True)
+    return rets
+
+
+# --------------------------------------------------------------------------
+# symlog / two-hot
+# --------------------------------------------------------------------------
+
+def symlog(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x: jax.Array) -> jax.Array:
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optional[int] = None) -> jax.Array:
+    """Symlog two-hot encoding onto a symmetric integer support.
+
+    A scalar ``v`` (after symlog) is split between its two neighboring bucket
+    centers with linear weights (reference: sheeprl/utils/utils.py:156-205,
+    default 300 range / 601 buckets; DreamerV3 uses its own 255-bin variant
+    through TwoHotEncodingDistribution).  Vectorized: no loops, one scatter.
+    ``x``: (..., 1) → (..., num_buckets).
+    """
+    if num_buckets is None:
+        num_buckets = int(2 * support_range + 1)
+    x = symlog(x)
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
+    below = jnp.sum((buckets <= x).astype(jnp.int32), axis=-1) - 1
+    below = jnp.clip(below, 0, num_buckets - 1)
+    above = jnp.clip(below + 1, 0, num_buckets - 1)
+    x0 = jnp.squeeze(x, -1)
+    dist_below = jnp.abs(buckets[below] - x0)
+    dist_above = jnp.abs(buckets[above] - x0)
+    total = dist_below + dist_above
+    total = jnp.where(total == 0, 1.0, total)
+    w_below = dist_above / total
+    w_above = dist_below / total
+    enc = (
+        jax.nn.one_hot(below, num_buckets, dtype=x.dtype) * w_below[..., None]
+        + jax.nn.one_hot(above, num_buckets, dtype=x.dtype) * w_above[..., None]
+    )
+    return enc
+
+
+def two_hot_decoder(probs: jax.Array, support_range: int = 300) -> jax.Array:
+    """Inverse of :func:`two_hot_encoder`: expectation over bucket centers,
+    then symexp.  (..., num_buckets) → (..., 1)."""
+    num_buckets = probs.shape[-1]
+    buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=probs.dtype)
+    return symexp(jnp.sum(probs * buckets, axis=-1, keepdims=True))
+
+
+# --------------------------------------------------------------------------
+# misc numerics
+# --------------------------------------------------------------------------
+
+def normalize_tensor(x: jax.Array, eps: float = 1e-8) -> jax.Array:
+    return (x - x.mean()) / (x.std() + eps)
+
+
+def polynomial_decay(
+    current_step: int,
+    *,
+    initial: float = 1.0,
+    final: float = 0.0,
+    max_decay_steps: int = 100,
+    power: float = 1.0,
+) -> float:
+    """Host-side polynomial schedule (reference: sheeprl/utils/utils.py:133-144)."""
+    if current_step > max_decay_steps or initial == final:
+        return final
+    frac = (1 - current_step / max_decay_steps) ** power
+    return (initial - final) * frac + final
+
+
+def safetanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    return jnp.clip(jnp.tanh(x), -1.0 + eps, 1.0 - eps)
+
+
+def safeatanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
+    return jnp.arctanh(jnp.clip(x, -1.0 + eps, 1.0 - eps))
+
+
+# --------------------------------------------------------------------------
+# replay-ratio governor
+# --------------------------------------------------------------------------
+
+class Ratio:
+    """Keeps gradient-steps : env-steps at a configured ratio.
+
+    Host-side control flow by design: the number of updates per iteration is
+    data-dependent, which must stay outside jit (SURVEY.md §7 hard part 2).
+    Mirrors the accounting of the reference governor
+    (reference: sheeprl/utils/utils.py:259-300).
+    """
+
+    def __init__(self, ratio: float, pretrain_steps: int = 0):
+        if pretrain_steps < 0:
+            raise ValueError(f"pretrain_steps must be non-negative, got {pretrain_steps}")
+        if ratio < 0:
+            raise ValueError(f"ratio must be non-negative, got {ratio}")
+        self._ratio = float(ratio)
+        self._pretrain_steps = int(pretrain_steps)
+        self._prev_in_steps = 0
+        self._accum = 0.0
+
+    def __call__(self, in_steps: int) -> int:
+        out = 0
+        if self._prev_in_steps == 0 and self._pretrain_steps > 0:
+            out = self._pretrain_steps
+        delta = in_steps - self._prev_in_steps
+        self._accum += delta * self._ratio
+        whole = int(self._accum)
+        out += whole
+        self._accum -= whole
+        self._prev_in_steps = in_steps
+        return out
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "ratio": self._ratio,
+            "pretrain_steps": self._pretrain_steps,
+            "prev_in_steps": self._prev_in_steps,
+            "accum": self._accum,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> "Ratio":
+        self._ratio = float(state["ratio"])
+        self._pretrain_steps = int(state["pretrain_steps"])
+        self._prev_in_steps = int(state["prev_in_steps"])
+        self._accum = float(state["accum"])
+        return self
+
+
+# --------------------------------------------------------------------------
+# config persistence / misc host helpers
+# --------------------------------------------------------------------------
+
+def save_configs(cfg: Any, log_dir: str) -> None:
+    os.makedirs(log_dir, exist_ok=True)
+    as_dict = cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)
+    with open(os.path.join(log_dir, "config.yaml"), "w") as f:
+        yaml.safe_dump(as_dict, f, sort_keys=False)
+
+
+def print_config(cfg: Any) -> None:
+    try:
+        from rich.pretty import pprint
+
+        pprint(cfg.as_dict() if hasattr(cfg, "as_dict") else cfg, expand_all=False)
+    except Exception:
+        print(yaml.safe_dump(cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg)))
+
+
+def unwrap_fabric(module: Any) -> Any:  # parity shim; no wrapping in JAX
+    return module
+
+
+def dict_to_numpy(tree: Dict[str, Any]) -> Dict[str, np.ndarray]:
+    return {k: np.asarray(v) for k, v in tree.items()}
+
+
+def copy_cfg(cfg: Any) -> Any:
+    return copy.deepcopy(cfg)

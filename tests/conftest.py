@@ -1,0 +1,36 @@
+"""Test harness setup.
+
+Mirrors the reference's test strategy (reference: tests/conftest.py:20-76 and
+tests/test_algos/test_algos.py:16-53): multi-device coverage without real
+hardware.  Here that means forcing the CPU XLA backend with 8 virtual devices
+(``xla_force_host_platform_device_count``) *before* JAX initializes, so mesh /
+sharding / collective code paths run everywhere.
+"""
+
+import os
+
+# Must happen before any jax import anywhere in the test session.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_env():
+    """Detect and undo environment-variable leaks between tests."""
+    saved = dict(os.environ)
+    yield
+    for k in set(os.environ) - set(saved):
+        del os.environ[k]
+    for k, v in saved.items():
+        if os.environ.get(k) != v:
+            os.environ[k] = v
+
+
+@pytest.fixture()
+def tmp_logdir(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    return tmp_path

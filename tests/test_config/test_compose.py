@@ -1,0 +1,87 @@
+import os
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.config.compose import ConfigError, compose
+from sheeprl_tpu.utils.structured import deep_merge, dotdict, get_by_path, set_by_path
+
+
+def base_overrides():
+    return [
+        "env=default",
+        "env.id=CartPole-v1",
+        "algo.name=x",
+        "algo.total_steps=64",
+        "algo.per_rank_batch_size=4",
+    ]
+
+
+def test_defaults_tree_composes():
+    cfg = compose(base_overrides())
+    for group in ("algo", "buffer", "checkpoint", "distribution", "env", "fabric", "metric", "model_manager"):
+        assert group in cfg, group
+    assert cfg.env.num_envs == 4
+    assert cfg.fabric.devices == 1
+
+
+def test_dot_overrides_and_yaml_typing():
+    cfg = compose(base_overrides() + ["env.num_envs=16", "fabric.precision=bf16-mixed", "dry_run=True"])
+    assert cfg.env.num_envs == 16 and isinstance(cfg.env.num_envs, int)
+    assert cfg.dry_run is True
+    assert cfg.fabric.precision == "bf16-mixed"
+
+
+def test_interpolation_resolution():
+    cfg = compose(base_overrides() + ["seed=9"])
+    assert cfg.exp_name == "x_CartPole-v1"
+    assert cfg.metric.logger.root_dir.endswith("x/CartPole-v1")
+    assert "${" not in str(cfg.run_name)
+
+
+def test_new_key_via_plus_override():
+    cfg = compose(base_overrides() + ["+algo.brand_new=3"])
+    assert cfg.algo.brand_new == 3
+
+
+def test_unknown_group_file_raises():
+    with pytest.raises(ConfigError):
+        compose(["env=this_env_does_not_exist"])
+
+
+def test_search_path_extension(tmp_path, monkeypatch):
+    # SHEEPRL_SEARCH_PATH adds out-of-tree config dirs, like the reference's
+    # hydra plugin (reference: hydra_plugins/sheeprl_search_path.py:11-33).
+    (tmp_path / "exp").mkdir()
+    (tmp_path / "exp" / "custom.yaml").write_text(
+        textwrap.dedent(
+            """
+            algo:
+              name: custom_algo
+              total_steps: 1
+              per_rank_batch_size: 1
+            env:
+              id: none
+            """
+        )
+    )
+    monkeypatch.setenv("SHEEPRL_SEARCH_PATH", str(tmp_path))
+    cfg = compose(["exp=custom", "env=default"])
+    assert cfg.algo.name == "custom_algo"
+
+
+def test_eval_and_env_resolvers(monkeypatch):
+    monkeypatch.setenv("MY_TEST_VAR", "21")
+    cfg = compose(base_overrides() + ["+algo.derived=${eval:2*3}", "+algo.from_env=${env:MY_TEST_VAR,0}"])
+    assert cfg.algo.derived == 6
+    assert cfg.algo.from_env == 21 or cfg.algo.from_env == "21"
+
+
+def test_dotdict_helpers():
+    d = dotdict({"a": {"b": 1}})
+    assert d.a.b == 1
+    set_by_path(d, "a.c.d", 5)
+    assert get_by_path(d, "a.c.d") == 5
+    merged = deep_merge({"x": {"y": 1, "z": 2}}, {"x": {"y": 10}})
+    assert merged == {"x": {"y": 10, "z": 2}}
+    assert d.as_dict() == {"a": {"b": 1, "c": {"d": 5}}}

@@ -1,0 +1,195 @@
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import (
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+
+
+def make_step(t, n_envs=2, obs_dim=3):
+    return {
+        "obs": np.full((1, n_envs, obs_dim), t, dtype=np.float32),
+        "actions": np.full((1, n_envs, 1), t, dtype=np.float32),
+        "rewards": np.full((1, n_envs, 1), t, dtype=np.float32),
+        "dones": np.zeros((1, n_envs, 1), dtype=np.float32),
+    }
+
+
+class TestReplayBuffer:
+    def test_add_and_len(self):
+        rb = ReplayBuffer(8, n_envs=2)
+        for t in range(5):
+            rb.add(make_step(t))
+        assert len(rb) == 5 and not rb.full
+
+    def test_ring_wraparound(self):
+        rb = ReplayBuffer(4, n_envs=2)
+        for t in range(6):
+            rb.add(make_step(t))
+        assert rb.full and len(rb) == 4
+        # oldest remaining value is t=2
+        assert rb["obs"].min() == 2
+
+    def test_multi_step_add(self):
+        rb = ReplayBuffer(10, n_envs=2)
+        data = {k: np.concatenate([make_step(t)[k] for t in range(3)]) for k in make_step(0)}
+        rb.add(data)
+        assert len(rb) == 3
+
+    def test_oversized_add_keeps_tail(self):
+        rb = ReplayBuffer(4, n_envs=2)
+        data = {k: np.concatenate([make_step(t)[k] for t in range(7)]) for k in make_step(0)}
+        rb.add(data)
+        assert rb.full
+        assert rb["obs"].min() == 3
+
+    def test_sample_shapes(self):
+        rb = ReplayBuffer(16, n_envs=2)
+        for t in range(10):
+            rb.add(make_step(t))
+        batch = rb.sample(6, n_samples=3)
+        assert batch["obs"].shape == (3, 6, 3)
+        assert batch["rewards"].shape == (3, 6, 1)
+
+    def test_sample_next_obs_excludes_write_head(self):
+        rb = ReplayBuffer(4, n_envs=1, obs_keys=("obs",))
+        for t in range(6):
+            rb.add(make_step(t, n_envs=1))
+        batch = rb.sample(64, sample_next_obs=True)
+        # successor of value v must always be v+1 (never the wrap to oldest)
+        assert np.all(batch["next_obs"] - batch["obs"] == 1)
+
+    def test_sample_empty_raises(self):
+        rb = ReplayBuffer(4)
+        with pytest.raises(RuntimeError):
+            rb.sample(1)
+
+    def test_memmap_roundtrip(self, tmp_path):
+        rb = ReplayBuffer(8, n_envs=2, memmap=True, memmap_dir=tmp_path / "rb")
+        for t in range(8):
+            rb.add(make_step(t))
+        assert rb.is_memmap
+        assert (tmp_path / "rb" / "obs.memmap").exists()
+        assert rb["obs"][3, 0, 0] == 3
+
+    def test_state_dict_roundtrip(self):
+        rb = ReplayBuffer(8, n_envs=2)
+        for t in range(5):
+            rb.add(make_step(t))
+        rb2 = ReplayBuffer(8, n_envs=2)
+        rb2.load_state_dict(rb.state_dict())
+        assert len(rb2) == 5
+        assert np.array_equal(rb2["obs"], rb["obs"])
+        bad = ReplayBuffer(4, n_envs=2)
+        with pytest.raises(ValueError):
+            bad.load_state_dict(rb.state_dict())
+
+
+class TestSequentialReplayBuffer:
+    def test_sequence_shapes_and_contiguity(self):
+        rb = SequentialReplayBuffer(32, n_envs=2)
+        for t in range(20):
+            rb.add(make_step(t))
+        batch = rb.sample(5, sequence_length=8, n_samples=2)
+        assert batch["obs"].shape == (2, 8, 5, 3)
+        # contiguity: consecutive steps differ by exactly 1
+        diffs = np.diff(batch["obs"][..., 0], axis=1)
+        assert np.all(diffs == 1)
+
+    def test_wraparound_sequences_stay_ordered(self):
+        rb = SequentialReplayBuffer(16, n_envs=1)
+        for t in range(24):
+            rb.add(make_step(t, n_envs=1))
+        batch = rb.sample(16, sequence_length=4)
+        diffs = np.diff(batch["obs"][..., 0], axis=1)
+        assert np.all(diffs == 1)
+        assert batch["obs"].min() >= 8  # oldest surviving step
+
+    def test_too_short_raises(self):
+        rb = SequentialReplayBuffer(16, n_envs=1)
+        for t in range(3):
+            rb.add(make_step(t, n_envs=1))
+        with pytest.raises(RuntimeError):
+            rb.sample(1, sequence_length=8)
+
+
+class TestEnvIndependentReplayBuffer:
+    def test_per_env_add_and_sample(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=3, buffer_cls=SequentialReplayBuffer)
+        for t in range(12):
+            rb.add(make_step(t, n_envs=3))
+        # add two extra steps only for env 1
+        rb.add(make_step(99, n_envs=1), indices=[1])
+        batch = rb.sample(6, sequence_length=4)
+        assert batch["obs"].shape == (1, 4, 6, 3)
+
+    def test_uniform_buffer_cls(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=2, buffer_cls=ReplayBuffer)
+        for t in range(10):
+            rb.add(make_step(t))
+        batch = rb.sample(8)
+        assert batch["obs"].shape == (1, 8, 3)
+
+
+class TestEpisodeBuffer:
+    def make_episode_data(self, length, n_envs=1, value=0.0):
+        d = make_step(value, n_envs=n_envs)
+        data = {k: np.repeat(v, length, axis=0) for k, v in d.items()}
+        data["dones"][-1] = 1.0
+        return data
+
+    def test_commit_on_done(self):
+        eb = EpisodeBuffer(100, sequence_length=4, n_envs=1)
+        eb.add(self.make_episode_data(10))
+        assert len(eb) == 10
+        assert len(eb.buffer) == 1
+
+    def test_short_episode_dropped(self):
+        eb = EpisodeBuffer(100, sequence_length=4, n_envs=1)
+        eb.add(self.make_episode_data(2))
+        assert len(eb) == 0
+
+    def test_eviction(self):
+        eb = EpisodeBuffer(20, sequence_length=4, n_envs=1)
+        for i in range(5):
+            eb.add(self.make_episode_data(8, value=i))
+        assert len(eb) <= 20
+
+    def test_sample_shapes(self):
+        eb = EpisodeBuffer(1000, sequence_length=4, n_envs=2)
+        for _ in range(3):
+            eb.add(self.make_episode_data(16, n_envs=2))
+        batch = eb.sample(5, n_samples=2, sequence_length=4)
+        assert batch["obs"].shape == (2, 4, 5, 3)
+
+    def test_open_episode_not_sampled(self):
+        eb = EpisodeBuffer(100, sequence_length=2, n_envs=1)
+        data = self.make_episode_data(6)
+        data["dones"][-1] = 0.0  # never closes
+        eb.add(data)
+        with pytest.raises(RuntimeError):
+            eb.sample(1)
+
+
+class TestReviewRegressions:
+    def test_sequential_sample_next_obs(self):
+        rb = SequentialReplayBuffer(32, n_envs=1, obs_keys=("obs",))
+        for t in range(20):
+            rb.add(make_step(t, n_envs=1))
+        batch = rb.sample(8, sequence_length=4, sample_next_obs=True)
+        assert "next_obs" in batch
+        assert np.all(batch["next_obs"] - batch["obs"] == 1)
+
+    def test_env_independent_skips_short_subbuffers(self):
+        rb = EnvIndependentReplayBuffer(32, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        # env 0 gets 10 steps, env 1 only 2 (< sequence_length)
+        for t in range(10):
+            rb.add(make_step(t, n_envs=1), indices=[0])
+        for t in range(2):
+            rb.add(make_step(t, n_envs=1), indices=[1])
+        for _ in range(10):  # must never crash by picking env 1
+            batch = rb.sample(4, sequence_length=8)
+            assert batch["obs"].shape == (1, 8, 4, 3)

@@ -1,0 +1,49 @@
+import pickle
+
+import numpy as np
+
+from sheeprl_tpu.data.memmap import MemmapArray
+
+
+def test_from_array_roundtrip(tmp_path):
+    src = np.arange(24, dtype=np.float32).reshape(4, 6)
+    m = MemmapArray.from_array(src, filename=tmp_path / "a.memmap")
+    assert np.array_equal(np.asarray(m), src)
+    assert m.shape == (4, 6) and m.dtype == np.float32
+
+
+def test_setitem_persists(tmp_path):
+    m = MemmapArray((4, 2), np.float32, filename=tmp_path / "b.memmap")
+    m[1] = 7.0
+    m.flush()
+    m2 = MemmapArray((4, 2), np.float32, filename=tmp_path / "b.memmap")
+    assert np.all(m2[1] == 7.0)
+
+
+def test_pickle_reopens_map(tmp_path):
+    m = MemmapArray.from_array(np.ones((3, 3)), filename=tmp_path / "c.memmap")
+    m2 = pickle.loads(pickle.dumps(m))
+    assert np.array_equal(np.asarray(m2), np.ones((3, 3)))
+    m2[0, 0] = 5
+    assert m[0, 0] == 5  # same backing file
+
+
+def test_ufunc_and_len(tmp_path):
+    m = MemmapArray.from_array(np.full((5,), 2.0), filename=tmp_path / "d.memmap")
+    assert len(m) == 5
+    assert np.all((m + 1) == 3.0)
+
+
+def test_close_without_delete(tmp_path):
+    m = MemmapArray.from_array(np.zeros((2,)), filename=tmp_path / "e.memmap")
+    m.close(delete_file=False)
+    assert (tmp_path / "e.memmap").exists()
+
+
+def test_anonymous_tempfile_cleanup():
+    m = MemmapArray((4,), np.float32)
+    path = m.filename
+    m.close()  # owner → deletes
+    import os
+
+    assert not os.path.exists(path)

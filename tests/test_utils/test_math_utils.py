@@ -1,0 +1,109 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    gae,
+    lambda_returns,
+    polynomial_decay,
+    symexp,
+    symlog,
+    two_hot_decoder,
+    two_hot_encoder,
+)
+
+
+def reference_gae(rewards, values, dones, next_value, gamma, lmbda):
+    """Straight-line numpy reimplementation of the textbook recursion."""
+    T = rewards.shape[0]
+    adv = np.zeros_like(rewards)
+    lastgaelam = np.zeros_like(next_value)
+    nv = next_value
+    for t in reversed(range(T)):
+        nd = 1.0 - dones[t]
+        delta = rewards[t] + gamma * nv * nd - values[t]
+        lastgaelam = delta + gamma * lmbda * nd * lastgaelam
+        adv[t] = lastgaelam
+        nv = values[t]
+    return adv + values, adv
+
+
+def test_gae_matches_reference_recursion():
+    rng = np.random.default_rng(0)
+    T, B = 12, 4
+    rewards = rng.normal(size=(T, B)).astype(np.float32)
+    values = rng.normal(size=(T, B)).astype(np.float32)
+    dones = (rng.random((T, B)) < 0.2).astype(np.float32)
+    next_value = rng.normal(size=(B,)).astype(np.float32)
+    ret, adv = gae(jnp.array(rewards), jnp.array(values), jnp.array(dones), jnp.array(next_value), 0.99, 0.95)
+    ref_ret, ref_adv = reference_gae(rewards, values, dones, next_value, 0.99, 0.95)
+    np.testing.assert_allclose(np.asarray(adv), ref_adv, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ret), ref_ret, rtol=1e-4, atol=1e-5)
+
+
+def test_lambda_returns_terminal_bootstrap():
+    T, B = 8, 3
+    rewards = jnp.ones((T, B))
+    values = jnp.ones((T, B)) * 2.0
+    continues = jnp.ones((T, B)) * 0.99
+    rets = lambda_returns(rewards, values, continues, lmbda=0.95)
+    assert rets.shape == (T, B)
+    # all-continue, constant reward: returns exceed values
+    assert np.all(np.asarray(rets) > 1.0)
+
+
+def test_symlog_symexp_inverse():
+    x = jnp.array([-100.0, -1.0, 0.0, 0.5, 30.0])
+    np.testing.assert_allclose(np.asarray(symexp(symlog(x))), np.asarray(x), rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("value", [-42.3, -1.0, 0.0, 0.1, 7.77, 123.0])
+def test_two_hot_roundtrip(value):
+    x = jnp.array([[value]])
+    enc = two_hot_encoder(x, support_range=300)
+    assert enc.shape == (1, 601)
+    np.testing.assert_allclose(float(jnp.sum(enc)), 1.0, rtol=1e-5)
+    dec = two_hot_decoder(enc, support_range=300)
+    np.testing.assert_allclose(float(dec[0, 0]), value, rtol=1e-3, atol=1e-3)
+
+
+def test_two_hot_at_most_two_nonzero():
+    enc = two_hot_encoder(jnp.array([[3.7]]), support_range=300)
+    assert int(jnp.sum(enc > 0)) <= 2
+
+
+def test_polynomial_decay():
+    assert polynomial_decay(0, initial=1.0, final=0.0, max_decay_steps=10) == 1.0
+    assert polynomial_decay(10, initial=1.0, final=0.0, max_decay_steps=10) == 0.0
+    mid = polynomial_decay(5, initial=1.0, final=0.0, max_decay_steps=10)
+    assert 0.49 < mid < 0.51
+    assert polynomial_decay(99, initial=1.0, final=0.3, max_decay_steps=10) == 0.3
+
+
+class TestRatio:
+    def test_unit_ratio(self):
+        r = Ratio(1.0)
+        assert r(10) == 10
+        assert r(25) == 15
+
+    def test_fractional_ratio_accumulates(self):
+        r = Ratio(0.5)
+        total = sum(r(i) for i in range(1, 101))
+        assert total == 50
+
+    def test_pretrain_steps(self):
+        r = Ratio(1.0, pretrain_steps=7)
+        assert r(4) == 11
+
+    def test_state_roundtrip(self):
+        r = Ratio(0.3)
+        r(10)
+        r2 = Ratio(0.3).load_state_dict(r.state_dict())
+        assert r2(20) == r(20)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            Ratio(-1.0)
+        with pytest.raises(ValueError):
+            Ratio(1.0, pretrain_steps=-1)

@@ -1,0 +1,37 @@
+import pytest
+
+from sheeprl_tpu.utils.registry import (
+    algorithm_registry,
+    register_algorithm,
+    resolve_algorithm,
+)
+
+
+def test_register_and_resolve():
+    @register_algorithm(name="unit_test_algo")
+    def main(fabric, cfg):
+        return "ran"
+
+    entry = resolve_algorithm("unit_test_algo")
+    assert entry.module == __name__
+    assert entry.decoupled is False
+    algorithm_registry.pop("unit_test_algo")
+
+
+def test_decoupled_variant_selection():
+    @register_algorithm(name="unit_test_algo2")
+    def main(fabric, cfg):
+        pass
+
+    @register_algorithm(name="unit_test_algo2", decoupled=True)
+    def main_decoupled(fabric, cfg):
+        pass
+
+    assert resolve_algorithm("unit_test_algo2", decoupled=True).decoupled
+    assert not resolve_algorithm("unit_test_algo2", decoupled=False).decoupled
+    algorithm_registry.pop("unit_test_algo2")
+
+
+def test_unknown_algorithm_raises():
+    with pytest.raises(ValueError):
+        resolve_algorithm("definitely_not_registered")
