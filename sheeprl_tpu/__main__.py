@@ -1,0 +1,21 @@
+"""``python -m sheeprl_tpu`` — training CLI.
+
+Subcommand-style flags mirror the reference's extra entry points
+(reference: pyproject.toml:57-61): ``--eval``, ``--register-model``,
+``--agents``.
+"""
+
+import sys
+
+from sheeprl_tpu.cli import available_agents, evaluation, registration, run
+
+if __name__ == "__main__":
+    argv = sys.argv[1:]
+    if argv and argv[0] == "--eval":
+        evaluation(argv[1:])
+    elif argv and argv[0] == "--register-model":
+        registration(argv[1:])
+    elif argv and argv[0] == "--agents":
+        available_agents()
+    else:
+        run(argv)

@@ -1,0 +1,1 @@
+"""PPO (coupled + decoupled) — TPU-native implementation."""

@@ -1,0 +1,174 @@
+"""PPO agent (flax).
+
+Capability parity with the reference agent
+(reference: sheeprl/algos/ppo/agent.py:55-369): a MultiEncoder feature
+extractor feeding separate actor / critic MLP heads; continuous actions
+parameterize a Gaussian (mean + state-independent log-std head output),
+discrete and multi-discrete actions parameterize per-branch categoricals.
+
+Where the reference maintains a DDP-wrapped training agent plus a
+weight-tied single-device ``PPOPlayer`` (agent.py:352-369), the functional
+JAX design needs neither: the same pure ``apply`` serves rollout and train,
+and "weight tying" is just passing the same params pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.models.models import MLP, MultiEncoder, get_activation
+from sheeprl_tpu.utils.distribution import Categorical, Normal
+
+
+class PPOAgent(nn.Module):
+    actions_dim: Tuple[int, ...]
+    is_continuous: bool
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    encoder_cfg: Dict[str, Any]
+    actor_cfg: Dict[str, Any]
+    critic_cfg: Dict[str, Any]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> Tuple[jax.Array, jax.Array]:
+        enc = self.encoder_cfg
+        features = MultiEncoder(
+            cnn_keys=tuple(self.cnn_keys),
+            mlp_keys=tuple(self.mlp_keys),
+            cnn_channels=(32, 64, 64),
+            cnn_features_dim=enc.get("cnn_features_dim"),
+            mlp_sizes=(enc.get("dense_units", 64),) * enc.get("mlp_layers", 2),
+            mlp_layer_norm=enc.get("layer_norm", False),
+            mlp_features_dim=enc.get("mlp_features_dim"),
+            activation=enc.get("dense_act", "tanh"),
+            dtype=self.dtype,
+            name="feature_extractor",
+        )(obs)
+
+        actor_out = MLP(
+            hidden_sizes=(self.actor_cfg.get("dense_units", 64),) * self.actor_cfg.get("mlp_layers", 2),
+            output_dim=sum(self.actions_dim) * (2 if self.is_continuous else 1),
+            activation=self.actor_cfg.get("dense_act", "tanh"),
+            layer_norm=self.actor_cfg.get("layer_norm", False),
+            dtype=self.dtype,
+            name="actor",
+        )(features)
+
+        value = MLP(
+            hidden_sizes=(self.critic_cfg.get("dense_units", 64),) * self.critic_cfg.get("mlp_layers", 2),
+            output_dim=1,
+            activation=self.critic_cfg.get("dense_act", "tanh"),
+            layer_norm=self.critic_cfg.get("layer_norm", False),
+            dtype=self.dtype,
+            name="critic",
+        )(features)
+        return actor_out.astype(jnp.float32), value.astype(jnp.float32)
+
+
+def split_actor_out(
+    actor_out: jax.Array, actions_dim: Sequence[int], is_continuous: bool
+):
+    """Interpret the raw actor head output as distribution parameters."""
+    if is_continuous:
+        mean, log_std = jnp.split(actor_out, 2, axis=-1)
+        return mean, jnp.clip(log_std, -10.0, 2.0)
+    sections = []
+    start = 0
+    for d in actions_dim:
+        sections.append(actor_out[..., start:start + d])
+        start += d
+    return sections
+
+
+def sample_actions(
+    actor_out: jax.Array,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    key: jax.Array,
+    greedy: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns ``(actions, log_prob, entropy)``.
+
+    Discrete/multi-discrete actions come back as float indices ``(B, n_branches)``
+    (the storage layout the buffers use); continuous as ``(B, act_dim)``.
+    """
+    if is_continuous:
+        mean, log_std = split_actor_out(actor_out, actions_dim, True)
+        dist = Normal(mean, jnp.exp(log_std), event_dims=1)
+        action = dist.mode() if greedy else dist.sample(key)
+        return action, dist.log_prob(action), dist.entropy()
+    logits = split_actor_out(actor_out, actions_dim, False)
+    keys = jax.random.split(key, len(logits))
+    acts, lps, ents = [], [], []
+    for lg, k in zip(logits, keys):
+        d = Categorical(lg)
+        a = d.mode() if greedy else d.sample(k)
+        acts.append(a)
+        lps.append(d.log_prob(a))
+        ents.append(d.entropy())
+    actions = jnp.stack(acts, axis=-1).astype(jnp.float32)
+    return actions, sum(lps), sum(ents)
+
+
+def evaluate_actions(
+    actor_out: jax.Array,
+    actions: jax.Array,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+) -> Tuple[jax.Array, jax.Array]:
+    """Log-prob and entropy of stored rollout actions under current params."""
+    if is_continuous:
+        mean, log_std = split_actor_out(actor_out, actions_dim, True)
+        dist = Normal(mean, jnp.exp(log_std), event_dims=1)
+        return dist.log_prob(actions), dist.entropy()
+    logits = split_actor_out(actor_out, actions_dim, False)
+    lp = 0.0
+    ent = 0.0
+    for i, lg in enumerate(logits):
+        d = Categorical(lg)
+        lp = lp + d.log_prob(actions[..., i])
+        ent = ent + d.entropy()
+    return lp, ent
+
+
+def build_agent(
+    fabric: Any,
+    actions_dim: Sequence[int],
+    is_continuous: bool,
+    cfg: Any,
+    obs_space: Any,
+    agent_state: Optional[Dict[str, Any]] = None,
+) -> Tuple[PPOAgent, Any]:
+    """Construct the module and (replicated) params, optionally from a
+    checkpoint (reference: sheeprl/algos/ppo/agent.py:325-369)."""
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    agent = PPOAgent(
+        actions_dim=tuple(actions_dim),
+        is_continuous=is_continuous,
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        encoder_cfg=dict(cfg.algo.encoder),
+        actor_cfg=dict(cfg.algo.actor),
+        critic_cfg=dict(cfg.algo.critic),
+        dtype=fabric.precision.compute_dtype,
+    )
+    if agent_state is not None:
+        params = agent_state
+    else:
+        dummy = {}
+        for k in cnn_keys:
+            shape = obs_space[k].shape
+            # frame-stacked images arrive merged into channels
+            if len(shape) == 4:
+                shape = (*shape[1:3], shape[0] * shape[3])
+            dummy[k] = jnp.zeros((1, *shape), jnp.float32)
+        for k in mlp_keys:
+            dummy[k] = jnp.zeros((1, *obs_space[k].shape), jnp.float32)
+        params = agent.init(jax.random.PRNGKey(0), dummy)
+    return agent, fabric.replicate(params)

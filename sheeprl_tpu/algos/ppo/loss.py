@@ -1,0 +1,48 @@
+"""PPO losses (reference: sheeprl/algos/ppo/loss.py:1-75), pure jittable fns."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    if reduction == "none":
+        return x
+    raise ValueError(f"Unknown reduction '{reduction}'")
+
+
+def policy_loss(
+    new_logprobs: jax.Array,
+    old_logprobs: jax.Array,
+    advantages: jax.Array,
+    clip_coef: jax.Array,
+    reduction: str = "mean",
+) -> jax.Array:
+    ratio = jnp.exp(new_logprobs - old_logprobs)
+    surr1 = advantages * ratio
+    surr2 = advantages * jnp.clip(ratio, 1.0 - clip_coef, 1.0 + clip_coef)
+    return _reduce(-jnp.minimum(surr1, surr2), reduction)
+
+
+def value_loss(
+    new_values: jax.Array,
+    old_values: jax.Array,
+    returns: jax.Array,
+    clip_coef: jax.Array,
+    clip_vloss: bool,
+    reduction: str = "mean",
+) -> jax.Array:
+    if not clip_vloss:
+        return _reduce(0.5 * (new_values - returns) ** 2, reduction)
+    v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
+    losses = jnp.maximum((new_values - returns) ** 2, (v_clipped - returns) ** 2)
+    return _reduce(0.5 * losses, reduction)
+
+
+def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:
+    return _reduce(-entropy, reduction)

@@ -1,0 +1,112 @@
+"""PPO support utilities (reference: sheeprl/algos/ppo/utils.py:1-121)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(
+    obs: Dict[str, np.ndarray],
+    cnn_keys: Sequence[str] = (),
+    mlp_keys: Sequence[str] = (),
+) -> Dict[str, jax.Array]:
+    """Host numpy obs → device float arrays.
+
+    Images: uint8 ``(B, H, W, C)`` (or frame-stacked ``(B, S, H, W, C)``,
+    merged into channels) → float32 ``/ 255``.  Vectors → float32.
+    (reference: sheeprl/algos/ppo/utils.py:prepare_obs)
+    """
+    out: Dict[str, jax.Array] = {}
+    for k in cnn_keys:
+        x = np.asarray(obs[k])
+        if x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
+            b, s, h, w, c = x.shape
+            x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
+        out[k] = jnp.asarray(x, jnp.float32) / 255.0
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k]), jnp.float32)
+    return out
+
+
+def actions_for_env(actions: np.ndarray, action_space: gym.Space) -> np.ndarray:
+    """Stored float actions → what the env expects."""
+    if isinstance(action_space, gym.spaces.Discrete):
+        return actions.astype(np.int64).reshape(-1)
+    if isinstance(action_space, gym.spaces.MultiDiscrete):
+        return actions.astype(np.int64)
+    low = np.asarray(action_space.low, np.float32)
+    high = np.asarray(action_space.high, np.float32)
+    return np.clip(actions.astype(np.float32), low, high)
+
+
+def spaces_to_dims(action_space: gym.Space) -> Tuple[Tuple[int, ...], bool]:
+    """Action-space → (per-branch dims, is_continuous)."""
+    if isinstance(action_space, gym.spaces.Discrete):
+        return (int(action_space.n),), False
+    if isinstance(action_space, gym.spaces.MultiDiscrete):
+        return tuple(int(n) for n in action_space.nvec), False
+    if isinstance(action_space, gym.spaces.Box):
+        return (int(np.prod(action_space.shape)),), True
+    raise ValueError(f"Unsupported action space {type(action_space)}")
+
+
+def test(agent: Any, params: Any, cfg: Any, log_dir: str, logger: Any = None, greedy: bool = True) -> float:
+    """Greedy evaluation episode (reference: sheeprl/algos/ppo/utils.py:test)."""
+    from sheeprl_tpu.algos.ppo.agent import sample_actions
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, run_name=log_dir, prefix="test")()
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    actions_dim, is_continuous = spaces_to_dims(env.action_space)
+
+    @jax.jit
+    def act(p, o, k):
+        out, _ = agent.apply(p, o)
+        a, _, _ = sample_actions(out, actions_dim, is_continuous, k, greedy=greedy)
+        return a
+
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    done, cum_reward = False, 0.0
+    while not done:
+        batched = {k: np.asarray(v)[None] for k, v in obs.items()}
+        o = prepare_obs(batched, cnn_keys, mlp_keys)
+        key, sk = jax.random.split(key)
+        action = np.asarray(act(params, o, sk))[0]
+        obs, reward, terminated, truncated, _ = env.step(actions_for_env(action[None], env.action_space)[0])
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
+    return cum_reward
+
+
+def normalize_obs_keys(cfg: Any, obs_space: gym.spaces.Dict) -> None:
+    """Validate configured encoder keys against the env's observation space
+    (reference does this check in each algo main)."""
+    for group in ("cnn_keys", "mlp_keys"):
+        keys = cfg.algo[group].encoder
+        missing = [k for k in keys if k not in obs_space.spaces]
+        if missing:
+            raise ValueError(
+                f"Configured {group}.encoder={list(keys)} but {missing} not in "
+                f"observation space keys {list(obs_space.spaces)}"
+            )
+    if not cfg.algo.cnn_keys.encoder and not cfg.algo.mlp_keys.encoder:
+        raise ValueError("At least one of algo.cnn_keys.encoder / algo.mlp_keys.encoder must be set")

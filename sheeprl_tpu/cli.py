@@ -1,0 +1,213 @@
+"""CLI dispatchers.
+
+Parity with the reference CLI layer (reference: sheeprl/cli.py:23-450):
+``run`` (training), ``evaluation`` (from checkpoint), ``registration``
+(model export) and ``available_agents`` — minus Hydra: composition is done
+by :mod:`sheeprl_tpu.config.compose` with the same user-facing syntax.
+
+Usage:
+    python -m sheeprl_tpu exp=ppo env.id=CartPole-v1 fabric.devices=8
+    python -m sheeprl_tpu --eval checkpoint_path=... [overrides...]
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+import sys
+import warnings
+from typing import Any, Dict, List, Optional
+
+from sheeprl_tpu.config.compose import ConfigError, compose
+from sheeprl_tpu.utils.registry import (
+    algorithm_registry,
+    evaluation_registry,
+    resolve_algorithm,
+    resolve_entrypoint,
+)
+from sheeprl_tpu.utils.structured import deep_merge, dotdict
+
+
+def check_configs(cfg: dotdict) -> None:
+    """Config sanity validation (reference: sheeprl/cli.py:271-345)."""
+    if "algo" not in cfg or cfg.algo.get("name") in (None, "???"):
+        raise ConfigError(
+            "No algorithm specified: pass exp=<experiment> or algo=<name> "
+            f"(registered: {', '.join(sorted(algorithm_registry))})"
+        )
+    if algorithm_registry and cfg.algo.name not in algorithm_registry:
+        raise ConfigError(
+            f"Unknown algorithm '{cfg.algo.name}'. "
+            f"Registered: {', '.join(sorted(algorithm_registry))}"
+        )
+    if "env" not in cfg or cfg.env.get("id") in (None, "???"):
+        raise ConfigError("No environment specified: set env=<group> / env.id=<id>")
+    for field in ("total_steps", "per_rank_batch_size"):
+        if cfg.algo.get(field) in (None, "???"):
+            raise ConfigError(f"algo.{field} must be set")
+    strategy = cfg.fabric.get("strategy", "auto")
+    if strategy not in ("auto", "dp"):
+        warnings.warn(
+            f"fabric.strategy='{strategy}' is not recognized; the runtime is a "
+            "single-controller SPMD mesh ('auto'/'dp' are equivalent)",
+            UserWarning,
+        )
+
+
+def resume_from_checkpoint(cfg: dotdict) -> dotdict:
+    """Merge the previous run's saved config under the new one, keeping the
+    user's total_steps / learning_starts overrides
+    (reference: sheeprl/cli.py:23-57)."""
+    import yaml
+
+    ckpt_path = pathlib.Path(cfg.checkpoint.resume_from)
+    old_cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not old_cfg_path.is_file():
+        return cfg
+    with open(old_cfg_path) as f:
+        old = yaml.safe_load(f)
+    keep = {
+        "total_steps": cfg.algo.get("total_steps"),
+        "learning_starts": cfg.algo.get("learning_starts"),
+    }
+    merged = deep_merge(old, cfg.as_dict())
+    out = dotdict(merged)
+    for k, v in keep.items():
+        if v is not None:
+            out.algo[k] = v
+    out.checkpoint.resume_from = str(ckpt_path)
+    return out
+
+
+def run_algorithm(cfg: dotdict) -> None:
+    """Resolve, build the runtime, dispatch (reference: sheeprl/cli.py:60-199)."""
+    import jax
+
+    import sheeprl_tpu
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    sheeprl_tpu.register_all_algorithms()
+    entry = resolve_algorithm(cfg.algo.name, decoupled=cfg.fabric.get("decoupled"))
+    entrypoint = resolve_entrypoint(entry)
+
+    if cfg.get("matmul_precision"):
+        jax.config.update("jax_default_matmul_precision", cfg.matmul_precision)
+    fabric = build_fabric(cfg)
+    entrypoint(fabric, cfg)
+
+
+def run(argv: Optional[List[str]] = None) -> None:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg = compose(argv)
+    if cfg.checkpoint.get("resume_from"):
+        cfg = resume_from_checkpoint(cfg)
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all_algorithms()
+    check_configs(cfg)
+    from sheeprl_tpu.utils.utils import print_config
+
+    if cfg.get("print_config", True):
+        print_config(cfg)
+    run_algorithm(cfg)
+
+
+def evaluation(argv: Optional[List[str]] = None) -> None:
+    """Evaluate a checkpoint (reference: sheeprl/cli.py:202-268, 369-405)."""
+    import yaml
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ckpt_override = [a for a in argv if a.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ConfigError("evaluation requires checkpoint_path=<path-to-ckpt>")
+    ckpt_path = pathlib.Path(ckpt_override[0].split("=", 1)[1])
+    rest = [a for a in argv if not a.startswith("checkpoint_path=")]
+
+    run_cfg_path = ckpt_path.parent.parent / "config.yaml"
+    if not run_cfg_path.is_file():
+        raise ConfigError(f"Cannot find the run config next to the checkpoint: {run_cfg_path}")
+    with open(run_cfg_path) as f:
+        cfg = dotdict(yaml.safe_load(f))
+
+    # eval runs single-device, 1 env, no video by default
+    cfg.fabric.devices = 1
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = cfg.env.get("capture_video", False)
+    for ov in rest:
+        k, _, v = ov.partition("=")
+        from sheeprl_tpu.utils.structured import set_by_path
+        import yaml as _y
+
+        set_by_path(cfg, k.strip(), _y.safe_load(v))
+
+    import sheeprl_tpu
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    sheeprl_tpu.register_all_algorithms()
+    entries = evaluation_registry.get(cfg.algo.name)
+    if not entries:
+        raise ConfigError(
+            f"No evaluation registered for '{cfg.algo.name}' "
+            f"(available: {', '.join(sorted(evaluation_registry))})"
+        )
+    entry = entries[0]
+    import importlib
+
+    module = importlib.import_module(entry.module)
+    fn = getattr(module, entry.entrypoint)
+    fabric = build_fabric(cfg)
+    state = fabric.load(ckpt_path)
+    fn(fabric, cfg, state)
+
+
+def registration(argv: Optional[List[str]] = None) -> None:
+    """Export checkpointed models to the model store
+    (reference: sheeprl/cli.py:408-450)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ckpt_override = [a for a in argv if a.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ConfigError("registration requires checkpoint_path=<path-to-ckpt>")
+    ckpt_path = pathlib.Path(ckpt_override[0].split("=", 1)[1])
+    import yaml
+
+    with open(ckpt_path.parent.parent / "config.yaml") as f:
+        cfg = dotdict(yaml.safe_load(f))
+    import importlib
+
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all_algorithms()
+    entry = resolve_algorithm(cfg.algo.name)
+    utils_mod = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
+    log_models = getattr(utils_mod, "log_models_from_checkpoint", None)
+    if log_models is None:
+        raise ConfigError(f"Algorithm '{cfg.algo.name}' does not support model registration")
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    fabric = build_fabric(cfg)
+    state = fabric.load(ckpt_path)
+    log_models(fabric, cfg, state)
+
+
+def available_agents() -> None:
+    """Print the registered algorithms (reference: sheeprl/available_agents.py:7-34)."""
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all_algorithms()
+    try:
+        from rich.console import Console
+        from rich.table import Table
+
+        table = Table(title="sheeprl-tpu agents")
+        table.add_column("Algorithm")
+        table.add_column("Module")
+        table.add_column("Entrypoint")
+        table.add_column("Decoupled")
+        for name, entries in sorted(algorithm_registry.items()):
+            for e in entries:
+                table.add_row(name, e.module, e.entrypoint, str(e.decoupled))
+        Console().print(table)
+    except Exception:
+        for name, entries in sorted(algorithm_registry.items()):
+            for e in entries:
+                print(f"{name}\t{e.module}\t{e.entrypoint}\tdecoupled={e.decoupled}")

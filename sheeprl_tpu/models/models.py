@@ -1,0 +1,314 @@
+"""Model building blocks (flax.linen, channel-last, MXU-friendly).
+
+Capability parity with the reference block library
+(reference: sheeprl/models/models.py:16-525): MLP, CNN, DeCNN, NatureCNN,
+LayerNormGRUCell, MultiEncoder/MultiDecoder, dtype-preserving LayerNorm —
+redesigned for TPU:
+
+* images are NHWC (XLA TPU conv layout), not NCHW;
+* every module takes a ``dtype`` (compute) / ``param_dtype`` pair so bf16
+  activations hit the MXU while params stay fp32;
+* LayerNorm computes in fp32 and casts back (the reference forces fp32 LN
+  output for numerics, models.py:507-525 — here we keep the policy but
+  return the compute dtype, which is what XLA fuses best);
+* the recurrent cell is shaped for ``flax.linen.scan`` / ``lax.scan`` over
+  time — no per-step Python loops anywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+ModuleDef = Any
+Activation = Callable[[jax.Array], jax.Array]
+
+
+def get_activation(name: Union[str, Activation, None]) -> Activation:
+    if name is None:
+        return lambda x: x
+    if callable(name):
+        return name
+    table = {
+        "relu": nn.relu,
+        "tanh": jnp.tanh,
+        "silu": nn.silu,
+        "swish": nn.silu,
+        "gelu": nn.gelu,
+        "elu": nn.elu,
+        "leaky_relu": nn.leaky_relu,
+        "sigmoid": nn.sigmoid,
+        "identity": lambda x: x,
+    }
+    if name not in table:
+        raise ValueError(f"Unknown activation '{name}'")
+    return table[name]
+
+
+class LayerNorm(nn.Module):
+    """LayerNorm computed in fp32 for stability, output cast to ``dtype``."""
+
+    dtype: Any = jnp.float32
+    eps: float = 1e-5
+    use_scale: bool = True
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        y = nn.LayerNorm(
+            epsilon=self.eps,
+            use_scale=self.use_scale,
+            use_bias=self.use_bias,
+            dtype=jnp.float32,
+            param_dtype=jnp.float32,
+        )(x.astype(jnp.float32))
+        return y.astype(self.dtype)
+
+
+class MLP(nn.Module):
+    """Configurable dense stack (reference: models/models.py:16-119).
+
+    ``hidden_sizes`` plus optional ``output_dim`` head; per-layer LayerNorm /
+    dropout / activation.  ``flatten_dim`` flattens trailing dims before the
+    first layer.
+    """
+
+    hidden_sizes: Sequence[int] = ()
+    output_dim: Optional[int] = None
+    activation: Union[str, Activation] = "tanh"
+    layer_norm: bool = False
+    dropout_rate: float = 0.0
+    flatten_input: bool = False
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, deterministic: bool = True) -> jax.Array:
+        act = get_activation(self.activation)
+        if self.flatten_input and x.ndim > 1:
+            x = x.reshape(*x.shape[:1], -1) if x.ndim == 2 else x.reshape(*x.shape[:-3], -1)
+        x = x.astype(self.dtype)
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, dtype=self.dtype, param_dtype=self.param_dtype, name=f"dense_{i}")(x)
+            if self.layer_norm:
+                x = LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+            x = act(x)
+            if self.dropout_rate > 0.0:
+                x = nn.Dropout(self.dropout_rate, deterministic=deterministic)(x)
+        if self.output_dim is not None:
+            x = nn.Dense(
+                self.output_dim, dtype=self.dtype, param_dtype=self.param_dtype, name="head"
+            )(x)
+        return x
+
+
+class CNN(nn.Module):
+    """Conv stack over NHWC images (reference: models/models.py:122-202)."""
+
+    channels: Sequence[int]
+    kernel_sizes: Union[int, Sequence[int]] = 3
+    strides: Union[int, Sequence[int]] = 2
+    activation: Union[str, Activation] = "relu"
+    layer_norm: bool = False
+    flatten_output: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = get_activation(self.activation)
+        n = len(self.channels)
+        ks = [self.kernel_sizes] * n if isinstance(self.kernel_sizes, int) else list(self.kernel_sizes)
+        st = [self.strides] * n if isinstance(self.strides, int) else list(self.strides)
+        x = x.astype(self.dtype)
+        for i, (c, k, s) in enumerate(zip(self.channels, ks, st)):
+            x = nn.Conv(
+                c, (k, k), strides=(s, s), padding="SAME",
+                dtype=self.dtype, param_dtype=self.param_dtype, name=f"conv_{i}",
+            )(x)
+            if self.layer_norm:
+                x = LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+            x = act(x)
+        if self.flatten_output:
+            x = x.reshape(*x.shape[:-3], -1)
+        return x
+
+
+class DeCNN(nn.Module):
+    """Transposed-conv stack, NHWC (reference: models/models.py:205-285)."""
+
+    channels: Sequence[int]
+    kernel_sizes: Union[int, Sequence[int]] = 4
+    strides: Union[int, Sequence[int]] = 2
+    paddings: Union[str, int, Sequence[Any]] = "SAME"
+    activation: Union[str, Activation] = "relu"
+    layer_norm: bool = False
+    final_activation: Union[str, Activation, None] = None
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = get_activation(self.activation)
+        final_act = get_activation(self.final_activation)
+        n = len(self.channels)
+        ks = [self.kernel_sizes] * n if isinstance(self.kernel_sizes, int) else list(self.kernel_sizes)
+        st = [self.strides] * n if isinstance(self.strides, int) else list(self.strides)
+        x = x.astype(self.dtype)
+        for i, (c, k, s) in enumerate(zip(self.channels, ks, st)):
+            last = i == n - 1
+            x = nn.ConvTranspose(
+                c, (k, k), strides=(s, s), padding=self.paddings if isinstance(self.paddings, str) else "SAME",
+                dtype=self.dtype, param_dtype=self.param_dtype, name=f"deconv_{i}",
+            )(x)
+            if not last:
+                if self.layer_norm:
+                    x = LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+                x = act(x)
+            else:
+                x = final_act(x)
+        return x
+
+
+class NatureCNN(nn.Module):
+    """DQN-Nature conv encoder + dense head
+    (reference: models/models.py:288-328).  Input NHWC uint8/float."""
+
+    features_dim: int = 512
+    activation: Union[str, Activation] = "relu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        act = get_activation(self.activation)
+        x = x.astype(self.dtype)
+        for i, (c, k, s) in enumerate(((32, 8, 4), (64, 4, 2), (64, 3, 1))):
+            x = nn.Conv(
+                c, (k, k), strides=(s, s), padding="VALID",
+                dtype=self.dtype, param_dtype=self.param_dtype, name=f"conv_{i}",
+            )(x)
+            x = act(x)
+        x = x.reshape(*x.shape[:-3], -1)
+        x = nn.Dense(self.features_dim, dtype=self.dtype, param_dtype=self.param_dtype)(x)
+        return act(x)
+
+
+class LayerNormGRUCell(nn.Module):
+    """Hafner-variant GRU cell: LayerNorm on the fused input/recurrent
+    projection and a ``-1`` bias on the update gate
+    (reference: models/models.py:331-410) — the hot recurrent cell of all
+    Dreamers.
+
+    One fused ``Dense(3*units)`` matmul per step keeps the MXU busy; wrap
+    with ``flax.linen.scan`` (see :func:`scan_rnn`) for the time loop.
+    """
+
+    units: int
+    layer_norm: bool = True
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, h: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        inp = jnp.concatenate([x.astype(self.dtype), h.astype(self.dtype)], axis=-1)
+        parts = nn.Dense(
+            3 * self.units,
+            use_bias=not self.layer_norm,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype,
+            name="fused",
+        )(inp)
+        if self.layer_norm:
+            parts = LayerNorm(dtype=self.dtype, name="ln")(parts)
+        reset, cand, update = jnp.split(parts, 3, axis=-1)
+        reset = nn.sigmoid(reset)
+        cand = jnp.tanh(reset * cand)
+        update = nn.sigmoid(update - 1.0)
+        new_h = update * cand + (1.0 - update) * h.astype(self.dtype)
+        return new_h, new_h
+
+    @staticmethod
+    def initial_state(batch: int, units: int, dtype: Any = jnp.float32) -> jax.Array:
+        return jnp.zeros((batch, units), dtype)
+
+
+class MultiEncoder(nn.Module):
+    """Fuse per-key CNN and MLP encoders by concatenating feature vectors
+    (reference: models/models.py:413-475).
+
+    ``cnn_keys`` observations are concatenated on channels and encoded once;
+    ``mlp_keys`` are concatenated on features and encoded once — same fusion
+    strategy as the reference.
+    """
+
+    cnn_keys: Sequence[str]
+    mlp_keys: Sequence[str]
+    cnn_channels: Sequence[int] = (32, 64, 128, 256)
+    cnn_layer_norm: bool = False
+    cnn_features_dim: Optional[int] = None
+    mlp_sizes: Sequence[int] = (256, 256)
+    mlp_layer_norm: bool = False
+    mlp_features_dim: Optional[int] = None
+    activation: Union[str, Activation] = "silu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        act = get_activation(self.activation)
+        feats = []
+        if self.cnn_keys:
+            img = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
+            y = CNN(
+                channels=self.cnn_channels,
+                kernel_sizes=4,
+                strides=2,
+                activation=self.activation,
+                layer_norm=self.cnn_layer_norm,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="cnn_encoder",
+            )(img)
+            if self.cnn_features_dim:
+                y = act(
+                    nn.Dense(
+                        self.cnn_features_dim, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="cnn_proj",
+                    )(y)
+                )
+            feats.append(y)
+        if self.mlp_keys:
+            vec = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            y = MLP(
+                hidden_sizes=self.mlp_sizes,
+                activation=self.activation,
+                layer_norm=self.mlp_layer_norm,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="mlp_encoder",
+            )(vec)
+            if self.mlp_features_dim:
+                y = act(
+                    nn.Dense(
+                        self.mlp_features_dim, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="mlp_proj",
+                    )(y)
+                )
+            feats.append(y)
+        if not feats:
+            raise ValueError("MultiEncoder needs at least one cnn or mlp key")
+        return jnp.concatenate(feats, axis=-1)
+
+
+def cnn_forward(fn: Callable, x: jax.Array, image_ndim: int = 3) -> jax.Array:
+    """Flatten leading ``(T, B)`` dims around an image op, restore after —
+    the ``(T, B, *)`` convention adapter (reference: sheeprl/utils/model.py:165+)."""
+    lead = x.shape[:-image_ndim]
+    flat = x.reshape((-1,) + x.shape[-image_ndim:])
+    y = fn(flat)
+    return y.reshape(lead + y.shape[1:])

@@ -73,6 +73,9 @@ def _resolve_accelerator(accelerator: str) -> str:
     )
 
 
+_FORCED_CPU_PLATFORM = False
+
+
 class Fabric:
     """Runtime facade handed to every algorithm ``main(fabric, cfg)``."""
 
@@ -91,10 +94,23 @@ class Fabric:
         self.callbacks: List[Any] = []
         self._callback_cfg = callbacks or {}
 
+        global _FORCED_CPU_PLATFORM
+        if accelerator == "cpu":
+            # make CPU the default backend too (not just the device list), so
+            # jitted computations execute where the user asked; needed because
+            # TPU plugins may force their platform over JAX_PLATFORMS
+            jax.config.update("jax_platforms", "cpu")
+            _FORCED_CPU_PLATFORM = True
         platform = _resolve_accelerator(accelerator)
         try:
             all_devices = jax.devices(platform)
         except RuntimeError:
+            if _FORCED_CPU_PLATFORM and platform != "cpu":
+                raise RuntimeError(
+                    f"accelerator='{accelerator}' requested, but an earlier "
+                    "Fabric(accelerator='cpu') pinned this process to the CPU "
+                    "backend; use a fresh process for accelerator runs"
+                ) from None
             all_devices = jax.devices()
         if devices in ("auto", -1, "-1", None):
             n = len(all_devices)
@@ -142,6 +158,21 @@ class Fabric:
     @property
     def device(self) -> Any:
         return self.devices[0]
+
+    @property
+    def host_device(self) -> Any:
+        """The host (CPU) device used for the env-interaction "player" copy
+        of the policy.  Accelerator dispatch latency (100ms+ on tunneled
+        TPUs, nontrivial even on-pod) makes per-env-step device round-trips
+        the dominant cost of RL rollouts; inference for action selection runs
+        on host and the train step refreshes the host params once per
+        iteration — the single-process analogue of the reference's decoupled
+        player/trainer split (reference: sheeprl/algos/ppo/ppo_decoupled.py)."""
+        return jax.local_devices(backend="cpu")[0]
+
+    def to_host(self, tree: Any) -> Any:
+        """Copy a pytree to the host CPU device (one bulk transfer)."""
+        return jax.device_put(tree, self.host_device)
 
     # -- sharding helpers --------------------------------------------------
     def sharding(self, *spec: Any) -> NamedSharding:

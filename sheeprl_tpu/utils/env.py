@@ -189,6 +189,40 @@ def make_env(
     return thunk
 
 
+def episode_stats(info: Dict[str, Any]):
+    """Extract finished-episode (return, length) pairs from vector-env info
+    (gymnasium 1.x layout: masked dict-of-arrays under ``final_info``)."""
+    out = []
+    src = None
+    if isinstance(info.get("final_info"), dict) and "episode" in info["final_info"]:
+        src = info["final_info"]
+    elif "episode" in info:
+        src = info
+    if src is not None:
+        ep = src["episode"]
+        mask = np.asarray(src.get("_episode", ep.get("_r", np.ones_like(ep["r"], bool))))
+        for i in np.nonzero(mask)[0]:
+            out.append((float(ep["r"][i]), int(ep["l"][i])))
+    return out
+
+
+def final_obs_rows(info: Dict[str, Any], env_indices: np.ndarray, obs_keys) -> Optional[Dict[str, np.ndarray]]:
+    """Stack the real final observations of the given env rows from vector
+    info (``final_obs`` is an object array with None for running envs)."""
+    fo = info.get("final_obs")
+    if fo is None:
+        return None
+    rows = []
+    for i in env_indices:
+        entry = fo[i]
+        if entry is None:
+            return None
+        if not isinstance(entry, dict):
+            return None
+        rows.append(entry)
+    return {k: np.stack([np.asarray(r[k]) for r in rows]) for k in obs_keys}
+
+
 def vectorize(cfg: Any, thunks: list) -> gym.vector.VectorEnv:
     """Vectorize with SAME_STEP autoreset so rollout loops observe the
     pre-1.0 gymnasium semantics the algorithms are written against

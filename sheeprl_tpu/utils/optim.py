@@ -1,0 +1,127 @@
+"""Optimizer factory (optax).
+
+The reference hydra-instantiates torch optimizers from ``configs/optim/*``
+(reference: sheeprl/configs/optim/adam.yaml and sheeprl/optim/rmsprop_tf.py).
+Here the same config surface builds an optax chain: global-norm clipping →
+the base optimizer, with the learning rate exposed as an injectable
+hyperparameter so host-side schedules (polynomial anneal) can update it
+without recompilation.
+
+``rmsprop_tf`` reproduces TF-style RMSprop (epsilon inside the sqrt,
+square-average state initialized to ones) used by Dreamer V1/V2
+(reference: sheeprl/optim/rmsprop_tf.py:14+).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def rmsprop_tf(
+    learning_rate: Any,
+    decay: float = 0.9,
+    eps: float = 1e-10,
+    momentum: float = 0.0,
+    centered: bool = False,
+) -> optax.GradientTransformation:
+    """TF-flavored RMSprop: ``eps`` added inside the sqrt and ``square_avg``
+    initialized to ones (so early steps are not over-scaled)."""
+
+    def init_fn(params):
+        nu = jax.tree.map(jnp.ones_like, params)  # square avg, ones-init
+        mom = jax.tree.map(jnp.zeros_like, params) if momentum > 0 else None
+        mg = jax.tree.map(jnp.zeros_like, params) if centered else None
+        return {"nu": nu, "mom": mom, "mg": mg}
+
+    def update_fn(updates, state, params=None):
+        nu = jax.tree.map(lambda n, g: decay * n + (1 - decay) * g * g, state["nu"], updates)
+        if centered:
+            mg = jax.tree.map(lambda m, g: decay * m + (1 - decay) * g, state["mg"], updates)
+            denom = jax.tree.map(lambda n, m: jnp.sqrt(n - m * m + eps), nu, mg)
+        else:
+            mg = None
+            denom = jax.tree.map(lambda n: jnp.sqrt(n + eps), nu)
+        scaled = jax.tree.map(lambda g, d: g / d, updates, denom)
+        if momentum > 0:
+            mom = jax.tree.map(lambda b, s: momentum * b + s, state["mom"], scaled)
+            out = mom
+        else:
+            mom = None
+            out = scaled
+        out = jax.tree.map(lambda u: -u, out)
+        return out, {"nu": nu, "mom": mom, "mg": mg}
+
+    base = optax.GradientTransformation(init_fn, update_fn)
+    return optax.chain(base, optax.scale_by_learning_rate(learning_rate, flip_sign=False))
+
+
+def build_optimizer(
+    optim_cfg: Any,
+    max_grad_norm: Optional[float] = None,
+) -> optax.GradientTransformation:
+    """Build from an ``optim`` config group entry: {name, lr, eps, ...}."""
+    name = optim_cfg.get("name", "adam")
+    lr = float(optim_cfg.get("lr", 1e-3))
+    if name == "adam":
+        base = optax.inject_hyperparams(optax.adam)(
+            learning_rate=lr,
+            b1=float(optim_cfg.get("betas", [0.9, 0.999])[0]),
+            b2=float(optim_cfg.get("betas", [0.9, 0.999])[1]),
+            eps=float(optim_cfg.get("eps", 1e-8)),
+        )
+    elif name == "adamw":
+        base = optax.inject_hyperparams(optax.adamw)(
+            learning_rate=lr,
+            eps=float(optim_cfg.get("eps", 1e-8)),
+            weight_decay=float(optim_cfg.get("weight_decay", 1e-2)),
+        )
+    elif name == "sgd":
+        base = optax.inject_hyperparams(optax.sgd)(
+            learning_rate=lr, momentum=float(optim_cfg.get("momentum", 0.0))
+        )
+    elif name == "rmsprop_tf":
+        base = optax.inject_hyperparams(rmsprop_tf)(
+            learning_rate=lr,
+            decay=float(optim_cfg.get("alpha", 0.9)),
+            eps=float(optim_cfg.get("eps", 1e-10)),
+            momentum=float(optim_cfg.get("momentum", 0.0)),
+            centered=bool(optim_cfg.get("centered", False)),
+        )
+    else:
+        raise ValueError(f"Unknown optimizer '{name}'")
+    if max_grad_norm is not None and max_grad_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(float(max_grad_norm)), base)
+    return base
+
+
+def set_learning_rate(opt_state: Any, lr: float) -> Any:
+    """Update the injected learning rate in-place (returns the same state).
+
+    Handles both a bare ``InjectStatefulHyperparamsState`` (itself a
+    NamedTuple, i.e. a tuple — check it FIRST) and arbitrarily nested chains.
+    """
+    if hasattr(opt_state, "hyperparams") and isinstance(getattr(opt_state, "hyperparams"), dict):
+        if "learning_rate" in opt_state.hyperparams:
+            opt_state.hyperparams["learning_rate"] = jnp.asarray(lr, dtype=jnp.float32)
+            return opt_state
+    if isinstance(opt_state, tuple):
+        for s in opt_state:
+            set_learning_rate(s, lr)
+    return opt_state
+
+
+def get_learning_rate(opt_state: Any) -> Optional[float]:
+    """Read back the injected learning rate (for tests / logging)."""
+    if hasattr(opt_state, "hyperparams") and isinstance(getattr(opt_state, "hyperparams"), dict):
+        if "learning_rate" in opt_state.hyperparams:
+            return float(opt_state.hyperparams["learning_rate"])
+    if isinstance(opt_state, tuple):
+        for s in opt_state:
+            lr = get_learning_rate(s)
+            if lr is not None:
+                return lr
+    return None

@@ -15,6 +15,12 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The axon TPU plugin (sitecustomize) forces its own platform regardless of
+# JAX_PLATFORMS; the config update below wins.
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

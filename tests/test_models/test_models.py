@@ -1,0 +1,110 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.models.models import (
+    CNN,
+    DeCNN,
+    LayerNorm,
+    LayerNormGRUCell,
+    MLP,
+    MultiEncoder,
+    NatureCNN,
+    cnn_forward,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_mlp_shapes_and_head():
+    m = MLP(hidden_sizes=(32, 32), output_dim=5, layer_norm=True)
+    params = m.init(KEY, jnp.ones((4, 10)))
+    out = m.apply(params, jnp.ones((4, 10)))
+    assert out.shape == (4, 5)
+
+
+def test_mlp_bf16_compute_fp32_params():
+    m = MLP(hidden_sizes=(16,), output_dim=2, dtype=jnp.bfloat16)
+    params = m.init(KEY, jnp.ones((2, 8)))
+    leaf = jax.tree.leaves(params)[0]
+    assert leaf.dtype == jnp.float32
+    out = m.apply(params, jnp.ones((2, 8)))
+    assert out.dtype == jnp.bfloat16
+
+
+def test_cnn_nhwc():
+    m = CNN(channels=(16, 32), kernel_sizes=4, strides=2)
+    x = jnp.ones((2, 64, 64, 3))
+    params = m.init(KEY, x)
+    out = m.apply(params, x)
+    assert out.ndim == 2 and out.shape[0] == 2
+
+
+def test_decnn_upsamples():
+    m = DeCNN(channels=(16, 3), kernel_sizes=4, strides=2)
+    x = jnp.ones((2, 8, 8, 32))
+    params = m.init(KEY, x)
+    out = m.apply(params, x)
+    assert out.shape == (2, 32, 32, 3)
+
+
+def test_nature_cnn_output_dim():
+    m = NatureCNN(features_dim=512)
+    x = jnp.ones((3, 64, 64, 4))
+    params = m.init(KEY, x)
+    out = m.apply(params, x)
+    assert out.shape == (3, 512)
+
+
+def test_layernorm_gru_cell_step_and_scan():
+    cell = LayerNormGRUCell(units=32)
+    h0 = LayerNormGRUCell.initial_state(4, 32)
+    x = jnp.ones((4, 16))
+    params = cell.init(KEY, h0, x)
+    h1, _ = cell.apply(params, h0, x)
+    assert h1.shape == (4, 32)
+    assert not np.allclose(np.asarray(h1), 0)
+
+    # scan over time with the same params
+    xs = jnp.ones((10, 4, 16))
+
+    def step(h, x_t):
+        h, _ = cell.apply(params, h, x_t)
+        return h, h
+
+    hT, hs = jax.lax.scan(step, h0, xs)
+    assert hs.shape == (10, 4, 32)
+    np.testing.assert_allclose(np.asarray(hs[-1]), np.asarray(hT))
+
+
+def test_multi_encoder_fuses_keys():
+    enc = MultiEncoder(
+        cnn_keys=("rgb",), mlp_keys=("state",), cnn_channels=(8, 16), mlp_sizes=(32,)
+    )
+    obs = {"rgb": jnp.ones((2, 64, 64, 3)), "state": jnp.ones((2, 7))}
+    params = enc.init(KEY, obs)
+    out = enc.apply(params, obs)
+    assert out.ndim == 2 and out.shape[0] == 2
+
+
+def test_multi_encoder_requires_keys():
+    enc = MultiEncoder(cnn_keys=(), mlp_keys=())
+    with pytest.raises(ValueError):
+        enc.init(KEY, {})
+
+
+def test_cnn_forward_tb_adapter():
+    m = NatureCNN(features_dim=64)
+    x = jnp.ones((5, 2, 64, 64, 3))  # (T, B, H, W, C)
+    params = m.init(KEY, x.reshape(-1, 64, 64, 3))
+    out = cnn_forward(lambda img: m.apply(params, img), x)
+    assert out.shape == (5, 2, 64)
+
+
+def test_layernorm_dtype_preserved():
+    ln = LayerNorm(dtype=jnp.bfloat16)
+    x = jnp.ones((2, 8), jnp.bfloat16)
+    params = ln.init(KEY, x)
+    out = ln.apply(params, x)
+    assert out.dtype == jnp.bfloat16

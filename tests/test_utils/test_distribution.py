@@ -1,0 +1,139 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.utils.distribution import (
+    Bernoulli,
+    Categorical,
+    MSEDistribution,
+    MultiCategorical,
+    Normal,
+    OneHotCategorical,
+    SymlogDistribution,
+    TanhNormal,
+    TruncatedNormal,
+    TwoHotEncodingDistribution,
+    kl_categorical,
+    kl_normal,
+)
+
+KEY = jax.random.PRNGKey(0)
+
+
+def test_categorical_log_prob_and_entropy():
+    logits = jnp.log(jnp.array([[0.7, 0.2, 0.1]]))
+    d = Categorical(logits)
+    np.testing.assert_allclose(np.asarray(d.log_prob(jnp.array([0]))), np.log(0.7), rtol=1e-5)
+    uniform = Categorical(jnp.zeros((1, 4)))
+    np.testing.assert_allclose(np.asarray(uniform.entropy()), np.log(4), rtol=1e-5)
+    assert int(d.mode()[0]) == 0
+
+
+def test_categorical_sampling_distribution():
+    d = Categorical(jnp.log(jnp.array([0.8, 0.15, 0.05])))
+    samples = jax.vmap(lambda k: d.sample(k))(jax.random.split(KEY, 2000))
+    freq = np.bincount(np.asarray(samples), minlength=3) / 2000
+    np.testing.assert_allclose(freq, [0.8, 0.15, 0.05], atol=0.05)
+
+
+def test_multicategorical():
+    d = MultiCategorical([jnp.zeros((2, 3)), jnp.zeros((2, 4))])
+    a = d.sample(KEY)
+    assert a.shape == (2, 2)
+    lp = d.log_prob(a)
+    np.testing.assert_allclose(np.asarray(lp), np.log(1 / 3) + np.log(1 / 4), rtol=1e-5)
+
+
+def test_onehot_straight_through_gradient():
+    def loss(logits):
+        d = OneHotCategorical(logits)
+        s = d.rsample(KEY)
+        return jnp.sum(s * jnp.arange(3.0))
+
+    g = jax.grad(loss)(jnp.zeros((3,)))
+    assert np.any(np.asarray(g) != 0)  # gradient flows through probs
+
+
+def test_onehot_unimix():
+    sharp = jnp.array([100.0, 0.0, 0.0])
+    d = OneHotCategorical(sharp, unimix=0.01)
+    probs = np.asarray(d.probs)
+    assert probs.min() >= 0.01 / 3 - 1e-6
+
+
+def test_kl_categorical_self_zero():
+    d = OneHotCategorical(jnp.array([0.5, 1.0, -0.2]))
+    np.testing.assert_allclose(float(kl_categorical(d, d)), 0.0, atol=1e-6)
+
+
+def test_normal_log_prob_matches_scipy():
+    d = Normal(jnp.array(1.0), jnp.array(2.0))
+    from scipy.stats import norm
+
+    np.testing.assert_allclose(float(d.log_prob(jnp.array(0.5))), norm.logpdf(0.5, 1.0, 2.0), rtol=1e-5)
+
+
+def test_kl_normal_self_zero():
+    d = Normal(jnp.array([1.0]), jnp.array([2.0]), event_dims=1)
+    np.testing.assert_allclose(float(kl_normal(d, d)), 0.0, atol=1e-6)
+
+
+def test_tanh_normal_log_prob_is_corrected():
+    d = TanhNormal(jnp.zeros((5, 2)), jnp.ones((5, 2)))
+    a, lp = d.sample_and_log_prob(KEY)
+    assert a.shape == (5, 2) and lp.shape == (5,)
+    assert np.all(np.abs(np.asarray(a)) < 1.0)
+    # analytic check against change-of-variables with base log_prob
+    base = Normal(jnp.zeros((5, 2)), jnp.ones((5, 2)), event_dims=1)
+    pre = np.arctanh(np.clip(np.asarray(a), -0.999999, 0.999999))
+    expected = np.asarray(base.log_prob(jnp.array(pre))) - np.sum(
+        np.log(1 - np.asarray(a) ** 2 + 1e-7), axis=-1
+    )
+    np.testing.assert_allclose(np.asarray(lp), expected, rtol=1e-3, atol=1e-3)
+
+
+def test_truncated_normal_support_and_mass():
+    d = TruncatedNormal(jnp.zeros((1000,)), jnp.ones((1000,)) * 2.0)
+    s = d.sample(KEY)
+    assert np.all(np.abs(np.asarray(s)) <= 1.0)
+    # log_prob integrates to ~1 over [-1, 1]
+    xs = jnp.linspace(-0.999, 0.999, 500)
+    d1 = TruncatedNormal(jnp.zeros(()), jnp.ones(()) * 2.0)
+    dens = np.exp(np.asarray(jax.vmap(d1.log_prob)(xs)))
+    mass = np.trapezoid(dens, np.asarray(xs))
+    np.testing.assert_allclose(mass, 1.0, atol=0.02)
+
+
+def test_mse_and_symlog_distributions():
+    pred = jnp.array([[1.0, 2.0]])
+    target = jnp.array([[1.5, 2.0]])
+    d = MSEDistribution(pred, event_dims=1)
+    np.testing.assert_allclose(np.asarray(d.log_prob(target)), -0.25, rtol=1e-5)
+    sd = SymlogDistribution(jnp.zeros((1, 2)), event_dims=1)
+    assert np.asarray(sd.log_prob(jnp.zeros((1, 2))))[0] == 0.0
+    np.testing.assert_allclose(np.asarray(sd.mode()), 0.0, atol=1e-6)
+
+
+def test_two_hot_distribution_mean_recovers_target():
+    # put all logit mass exactly on the two-hot encoding of a target value
+    target = 3.7
+    d0 = TwoHotEncodingDistribution(jnp.zeros((1, 255)))
+    enc = d0._two_hot(jnp.array([[target]]))
+    d = TwoHotEncodingDistribution(jnp.log(enc + 1e-8))
+    np.testing.assert_allclose(float(d.mean[0, 0]), target, rtol=1e-2)
+
+
+def test_two_hot_log_prob_peaks_at_target():
+    logits = jax.random.normal(KEY, (1, 255))
+    d = TwoHotEncodingDistribution(logits)
+    lp_self = float(np.asarray(d.log_prob(d.mean)).reshape(-1)[0])
+    lp_far = float(np.asarray(d.log_prob(d.mean + 100.0)).reshape(-1)[0])
+    assert lp_self > lp_far
+
+
+def test_bernoulli_safe_mode():
+    d = Bernoulli(jnp.array([10.0, -10.0]))
+    np.testing.assert_allclose(np.asarray(d.mode()), [1.0, 0.0])
+    lp = d.log_prob(jnp.array([1.0, 0.0]))
+    assert np.all(np.asarray(lp) < 0) and np.all(np.asarray(lp) > -1e-3)

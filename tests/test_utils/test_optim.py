@@ -1,0 +1,37 @@
+import jax.numpy as jnp
+import optax
+
+from sheeprl_tpu.utils.optim import build_optimizer, get_learning_rate, rmsprop_tf, set_learning_rate
+
+
+def test_set_lr_on_bare_inject():
+    # max_grad_norm=0 → no chain wrapper (the review-found silent no-op)
+    opt = build_optimizer({"name": "adam", "lr": 1e-3}, max_grad_norm=None)
+    state = opt.init({"w": jnp.zeros(3)})
+    set_learning_rate(state, 5e-4)
+    assert abs(get_learning_rate(state) - 5e-4) < 1e-9
+
+
+def test_set_lr_on_chained():
+    opt = build_optimizer({"name": "adam", "lr": 1e-3}, max_grad_norm=0.5)
+    state = opt.init({"w": jnp.zeros(3)})
+    set_learning_rate(state, 1e-4)
+    assert abs(get_learning_rate(state) - 1e-4) < 1e-9
+
+
+def test_rmsprop_tf_square_avg_ones_init():
+    opt = rmsprop_tf(1e-2)
+    params = {"w": jnp.ones(4)}
+    state = opt.init(params)
+    grads = {"w": jnp.full(4, 0.1)}
+    updates, state = opt.update(grads, state, params)
+    new = optax.apply_updates(params, updates)
+    # ones-init square_avg keeps the first step small (unlike torch default)
+    assert float(jnp.abs(new["w"] - 1.0).max()) < 2e-3
+
+
+def test_unknown_optimizer_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        build_optimizer({"name": "nope"})
