@@ -1,0 +1,54 @@
+"""Benchmark harness (driver contract: prints ONE JSON line).
+
+Round-1 benchmark: PPO CartPole-v1 full training wall-clock — BASELINE.json
+config #1, the reference's own framework-overhead benchmark
+(reference: benchmarks/benchmark.py:1-52 runs exp=ppo_benchmarks and prints
+wall-clock; published number: 81.27 s on 4 CPUs, BASELINE.md).
+
+Same workload shape as the reference benchmark: total_steps=65536,
+4 envs × 128 rollout steps, logging/checkpoint/test disabled.
+``vs_baseline`` > 1 means faster than the reference.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+BASELINE_PPO_CARTPOLE_S = 81.27  # reference v0.5.5, BASELINE.md
+
+
+def bench_ppo_cartpole() -> dict:
+    from sheeprl_tpu.cli import run
+
+    args = [
+        "exp=ppo",
+        "env.id=CartPole-v1",
+        "env.num_envs=4",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "algo.total_steps=65536",
+        "algo.rollout_steps=128",
+        "algo.run_test=False",
+        "metric.log_level=0",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        "buffer.memmap=False",
+        "print_config=False",
+        "log_dir=/tmp/bench_logs",
+    ]
+    t0 = time.perf_counter()
+    run(args)
+    elapsed = time.perf_counter() - t0
+    return {
+        "metric": "ppo_cartpole_65536_steps_wall_clock",
+        "value": round(elapsed, 2),
+        "unit": "s",
+        "vs_baseline": round(BASELINE_PPO_CARTPOLE_S / elapsed, 3),
+    }
+
+
+if __name__ == "__main__":
+    result = bench_ppo_cartpole()
+    print(json.dumps(result))

@@ -1,0 +1,1 @@
+"""A2C — TPU-native implementation."""

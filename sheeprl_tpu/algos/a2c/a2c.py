@@ -1,0 +1,258 @@
+"""A2C, coupled topology.
+
+Capability parity with the reference (reference: sheeprl/algos/a2c/a2c.py:117-440):
+on-policy rollouts, GAE, one synchronized gradient step per rollout.
+
+The reference accumulates gradients across minibatches under
+``fabric.no_backward_sync`` so DDP all-reduces once per update
+(reference: a2c.py:53-116).  Gradient accumulation is a workaround for
+framework overhead, not an algorithmic feature — on TPU the mathematically
+identical thing is ONE jitted full-batch update per rollout (summed losses,
+single XLA-inserted gradient all-reduce), which is also the fastest mapping
+to the MXU.  Agent/encoder/player machinery is shared with PPO
+(sheeprl_tpu/algos/ppo/agent.py) — same module family in the reference too.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, sample_actions
+from sheeprl_tpu.algos.ppo.utils import (
+    actions_for_env,
+    normalize_obs_keys,
+    prepare_obs,
+    spaces_to_dims,
+    test,
+)
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    normalize_obs_keys(cfg, obs_space)
+    actions_dim, is_continuous = spaces_to_dims(act_space)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
+    )
+    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
+
+    aggregator = MetricAggregator(
+        cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {}
+    )
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    host = fabric.host_device
+    reduction = cfg.algo.loss_reduction
+    vf_coef = float(cfg.algo.vf_coef)
+    ent_coef = float(cfg.algo.ent_coef)
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+
+    @jax.jit
+    def policy_step_fn(p, obs, k):
+        out, value = agent.apply(p, obs)
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k)
+        return actions, logprob, value[..., 0]
+
+    @jax.jit
+    def values_fn(p, obs):
+        _, value = agent.apply(p, obs)
+        return value[..., 0]
+
+    player_params = fabric.to_host(params)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, rollout, last_obs):
+        """GAE + one full-batch gradient step, in one device program."""
+        T, B = rollout["rewards"].shape
+        flat_obs = {k: rollout[k].reshape((T * B,) + rollout[k].shape[2:]) for k in obs_keys}
+        _, values0 = agent.apply(p, flat_obs)
+        values0 = values0[..., 0].reshape(T, B)
+        next_value = values_fn(p, last_obs)
+        returns, advantages = gae(
+            rollout["rewards"], values0, rollout["dones"], next_value, gamma, gae_lambda
+        )
+
+        def loss_fn(p):
+            out, new_values = agent.apply(p, flat_obs)
+            lp, ent = evaluate_actions(
+                out, rollout["actions"].reshape(T * B, -1), actions_dim, is_continuous
+            )
+            pg = policy_loss(lp, advantages.reshape(-1), reduction)
+            vl = value_loss(new_values[..., 0], returns.reshape(-1), reduction)
+            e = ent.mean()
+            return pg + vf_coef * vl - ent_coef * e, (pg, vl, e)
+
+        (loss, (pg, vl, e)), grads = jax.value_and_grad(loss_fn, has_aux=True)(p)
+        updates, o_state = optimizer.update(grads, o_state, p)
+        p = optax.apply_updates(p, updates)
+        return p, o_state, (pg, vl, e)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_iter = num_envs * rollout_steps
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    base_lr = float(cfg.algo.optimizer.lr)
+
+    rb = ReplayBuffer(
+        rollout_steps,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        obs_keys=obs_keys,
+    )
+
+    step_data: Dict[str, np.ndarray] = {}
+    obs, _ = envs.reset(seed=cfg.seed)
+    last_losses = None
+
+    for update in range(start_iter, total_iters + 1):
+        with timer("Time/env_interaction_time"):
+            with jax.default_device(host):
+                for _ in range(rollout_steps):
+                    policy_step += num_envs
+                    dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
+                    key, sk = jax.random.split(key)
+                    actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
+                    actions_np = np.asarray(actions)
+                    next_obs, rewards, terminated, truncated, info = envs.step(
+                        actions_for_env(actions_np, act_space)
+                    )
+                    dones = np.logical_or(terminated, truncated)
+                    rewards = np.asarray(rewards, np.float32)
+                    if np.any(truncated):
+                        final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
+                        if final_obs is not None:
+                            padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+                            for k in obs_keys:
+                                padded[k][truncated] = final_obs[k]
+                            vals = np.asarray(
+                                values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys))
+                            )
+                            rewards[truncated] += gamma * vals[truncated]
+
+                    for k in obs_keys:
+                        step_data[k] = np.asarray(obs[k])[None]
+                    step_data["actions"] = actions_np[None]
+                    step_data["rewards"] = rewards[None]
+                    step_data["dones"] = dones[None].astype(np.float32)
+                    rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
+
+                    obs = next_obs
+                    for ep_ret, ep_len in episode_stats(info):
+                        aggregator.update("Rewards/rew_avg", ep_ret)
+                        aggregator.update("Game/ep_len_avg", ep_len)
+
+        with timer("Time/train_time"):
+            from sheeprl_tpu.algos.ppo.ppo import _obs_to_device
+
+            local = rb.buffer
+            rollout = {}
+            for k in obs_keys:
+                rollout[k] = _obs_to_device(local[k], k in cnn_keys)
+            rollout["actions"] = jnp.asarray(local["actions"])
+            rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
+            rollout["dones"] = jnp.asarray(local["dones"][..., 0])
+            if num_envs % fabric.world_size == 0:
+                rollout = fabric.shard_batch(rollout, axis=1)
+            else:
+                rollout = fabric.replicate(rollout)
+            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
+            params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
+            player_params = fabric.to_host(params)
+
+        if cfg.algo.anneal_lr:
+            new_lr = polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters)
+            opt_state = set_learning_rate(opt_state, new_lr)
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                pg, vl, e = last_losses
+                aggregator.update("Loss/policy_loss", pg)
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/entropy_loss", e)
+            metrics = aggregator.compute()
+            aggregator.reset()
+            times = timer.to_dict(reset=True)
+            steps_since = max(policy_step - last_log, 1)
+            if "Time/env_interaction_time" in times:
+                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+            if "Time/train_time" in times:
+                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+            metrics.update(times)
+            if logger is not None and metrics:
+                logger.log_metrics(metrics, policy_step)
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,24 @@
+"""A2C evaluation entrypoint (reference: sheeprl/algos/a2c/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.ppo.agent import build_agent
+from sheeprl_tpu.algos.ppo.utils import spaces_to_dims, test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="a2c")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    env = make_env(cfg, cfg.seed, 0)()
+    actions_dim, is_continuous = spaces_to_dims(env.action_space)
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, env.observation_space, state["agent"]
+    )
+    env.close()
+    test(agent, params, cfg, log_dir, logger)

@@ -1,0 +1,23 @@
+"""A2C losses (reference: sheeprl/algos/a2c/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _reduce(x: jax.Array, reduction: str) -> jax.Array:
+    if reduction == "mean":
+        return x.mean()
+    if reduction == "sum":
+        return x.sum()
+    return x
+
+
+def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "sum") -> jax.Array:
+    """Vanilla policy gradient: -E[logπ(a|s) · Â] (advantages stop-gradient)."""
+    return _reduce(-logprobs * jax.lax.stop_gradient(advantages), reduction)
+
+
+def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "sum") -> jax.Array:
+    return _reduce(0.5 * (values - returns) ** 2, reduction)

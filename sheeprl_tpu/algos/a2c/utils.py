@@ -1,0 +1,17 @@
+"""A2C support utilities (reference: sheeprl/algos/a2c/utils.py)."""
+
+from sheeprl_tpu.algos.ppo.utils import (  # noqa: F401 — same obs/test machinery
+    actions_for_env,
+    prepare_obs,
+    spaces_to_dims,
+    test,
+)
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}

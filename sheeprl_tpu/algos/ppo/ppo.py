@@ -34,6 +34,7 @@ from typing import Any, Dict
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, sample_actions
 from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
@@ -180,7 +181,7 @@ def main(fabric: Any, cfg: Any) -> None:
                     p, batch, clip_coef, ent_coef
                 )
                 updates, o_state = optimizer.update(grads, o_state, p)
-                p = jax.tree.map(lambda a, b: a + b, p, updates)
+                p = optax.apply_updates(p, updates)
                 return p, o_state, (pg, vl, ent)
 
             p, o_state, losses = jax.lax.fori_loop(

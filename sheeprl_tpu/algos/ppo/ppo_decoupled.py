@@ -1,0 +1,301 @@
+"""PPO, decoupled (player/learner-overlapped) topology.
+
+Capability parity with the reference's decoupled PPO
+(reference: sheeprl/algos/ppo/ppo_decoupled.py:32-670): env interaction and
+optimization proceed concurrently, with the player acting on slightly stale
+policy weights while trainers optimize.
+
+The reference implements this with N processes and three TorchCollective
+groups (world scatter, player↔trainer-1 weight broadcast, trainer DDP
+group).  The TPU-native equivalent needs NO process groups: JAX dispatch is
+asynchronous, so the single controller
+
+  1. dispatches the (donated, jitted) train phase for rollout *k* — the call
+     returns immediately while the device crunches;
+  2. collects rollout *k+1* on the host with the player params of rollout
+     *k-1* (a one-iteration staleness, same semantics as the reference's
+     player acting during trainer optimization);
+  3. then syncs the refreshed params to the host player — by which time the
+     device is done, so the transfer is the only wait.
+
+Gradient all-reduce across the mesh happens inside the jitted step (GSPMD),
+playing the role of the trainer DDP subgroup.  `fabric.devices` therefore
+still scales training exactly like adding trainer ranks in the reference.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, sample_actions
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import (
+    actions_for_env,
+    normalize_obs_keys,
+    prepare_obs,
+    spaces_to_dims,
+    test,
+)
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, save_configs
+
+
+@register_algorithm(decoupled=True, name="ppo_decoupled")
+def main(fabric: Any, cfg: Any) -> None:
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    normalize_obs_keys(cfg, obs_space)
+    actions_dim, is_continuous = spaces_to_dims(act_space)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
+    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    host = fabric.host_device
+    reduction = cfg.algo.loss_reduction
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_adv = bool(cfg.algo.normalize_advantages)
+    vf_coef = float(cfg.algo.vf_coef)
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    update_epochs = int(cfg.algo.update_epochs)
+
+    @jax.jit
+    def policy_step_fn(p, obs, k):
+        out, value = agent.apply(p, obs)
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k)
+        return actions, logprob, value[..., 0]
+
+    @jax.jit
+    def values_fn(p, obs):
+        _, value = agent.apply(p, obs)
+        return value[..., 0]
+
+    def loss_fn(p, batch, clip_coef, ent_coef):
+        out, new_values = agent.apply(p, {k: batch[k] for k in obs_keys})
+        new_logprobs, entropy = evaluate_actions(out, batch["actions"], actions_dim, is_continuous)
+        adv = batch["advantages"]
+        if normalize_adv:
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+        pg = policy_loss(new_logprobs, batch["logprobs"], adv, clip_coef, reduction)
+        vl = value_loss(new_values[..., 0], batch["values"], batch["returns"], clip_coef, clip_vloss, reduction)
+        ent = entropy_loss(entropy, reduction)
+        return pg + vf_coef * vl + ent_coef * ent, (pg, vl, ent)
+
+    @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("batch_size", "num_minibatches"))
+    def train_phase(p, o_state, rollout, last_obs, k, clip_coef, ent_coef, batch_size, num_minibatches):
+        T, B = rollout["rewards"].shape
+        flat_obs = {kk: rollout[kk].reshape((T * B,) + rollout[kk].shape[2:]) for kk in obs_keys}
+        _, values = agent.apply(p, flat_obs)
+        values = values[..., 0].reshape(T, B)
+        next_value = values_fn(p, last_obs)
+        returns, advantages = gae(rollout["rewards"], values, rollout["dones"], next_value, gamma, gae_lambda)
+        flat = dict(flat_obs)
+        flat["actions"] = rollout["actions"].reshape(T * B, -1)
+        flat["logprobs"] = rollout["logprobs"].reshape(T * B)
+        flat["values"] = values.reshape(T * B)
+        flat["returns"] = returns.reshape(T * B)
+        flat["advantages"] = advantages.reshape(T * B)
+
+        def epoch_body(carry, key_e):
+            p, o_state = carry
+            perm = jax.random.permutation(key_e, T * B)
+            pad = num_minibatches * batch_size - (T * B)
+            perm = jnp.concatenate([perm, perm[: max(pad, 0)]]) if pad > 0 else perm
+
+            def mb_body(i, carry2):
+                p, o_state, _ = carry2
+                idx = jax.lax.dynamic_slice(perm, (i * batch_size,), (batch_size,))
+                batch = {kk: jnp.take(vv, idx, axis=0) for kk, vv in flat.items()}
+                (_, (pg, vl, ent)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    p, batch, clip_coef, ent_coef
+                )
+                updates, o_state = optimizer.update(grads, o_state, p)
+                p = optax.apply_updates(p, updates)
+                return p, o_state, (pg, vl, ent)
+
+            p, o_state, losses = jax.lax.fori_loop(
+                0, num_minibatches, mb_body,
+                (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))),
+            )
+            return (p, o_state), losses
+
+        (p, o_state), losses = jax.lax.scan(epoch_body, (p, o_state), jax.random.split(k, update_epochs))
+        return p, o_state, jax.tree.map(lambda x: x[-1], losses)
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_iter = num_envs * rollout_steps
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    clip_coef_v = float(cfg.algo.clip_coef)
+    ent_coef_v = float(cfg.algo.ent_coef)
+
+    rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=obs_keys)
+
+    def collect_rollout(obs, player_params, key):
+        """One rollout with the (possibly stale) player params."""
+        nonlocal policy_step
+        with jax.default_device(host):
+            for _ in range(rollout_steps):
+                policy_step += num_envs
+                dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
+                key, sk = jax.random.split(key)
+                actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
+                actions_np = np.asarray(actions)
+                next_obs, rewards, terminated, truncated, info = envs.step(
+                    actions_for_env(actions_np, act_space)
+                )
+                dones = np.logical_or(terminated, truncated)
+                rewards = np.asarray(rewards, np.float32)
+                if np.any(truncated):
+                    final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
+                    if final_obs is not None:
+                        padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+                        for k in obs_keys:
+                            padded[k][truncated] = final_obs[k]
+                        vals = np.asarray(values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys)))
+                        rewards[truncated] += gamma * vals[truncated]
+                step_data = {}
+                for k in obs_keys:
+                    step_data[k] = np.asarray(obs[k])[None]
+                step_data["actions"] = actions_np[None]
+                step_data["logprobs"] = np.asarray(logprobs)[None]
+                step_data["rewards"] = rewards[None]
+                step_data["dones"] = dones[None].astype(np.float32)
+                rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
+                obs = next_obs
+                for ep_ret, ep_len in episode_stats(info):
+                    aggregator.update("Rewards/rew_avg", ep_ret)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+        from sheeprl_tpu.algos.ppo.ppo import _obs_to_device
+
+        local = rb.buffer
+        rollout = {}
+        for k in obs_keys:
+            rollout[k] = _obs_to_device(local[k], k in cnn_keys)
+        rollout["actions"] = jnp.asarray(local["actions"])
+        rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
+        rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
+        rollout["dones"] = jnp.asarray(local["dones"][..., 0])
+        return obs, rollout, key
+
+    T, B = rollout_steps, num_envs
+    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
+    num_minibatches = -(-T * B // global_bs)
+
+    def ship(rollout):
+        if num_envs % fabric.world_size == 0:
+            return fabric.shard_batch(rollout, axis=1)
+        return fabric.replicate(rollout)
+
+    # ---------------- pipelined main loop -----------------------------------
+    obs, _ = envs.reset(seed=cfg.seed)
+    player_params = fabric.to_host(params)
+    last_losses = None
+
+    with timer("Time/env_interaction_time"):
+        obs, rollout, key = collect_rollout(obs, player_params, key)
+
+    for update in range(start_iter, total_iters + 1):
+        # 1. dispatch training for rollout k (async — returns immediately)
+        with timer("Time/train_time"):
+            key, tk = jax.random.split(key)
+            params, opt_state, last_losses = train_phase(
+                params, opt_state, ship(rollout), prepare_obs(obs, cnn_keys, mlp_keys),
+                tk, jnp.float32(clip_coef_v), jnp.float32(ent_coef_v),
+                batch_size=global_bs, num_minibatches=num_minibatches,
+            )
+        # 2. collect rollout k+1 with the stale player while the device trains
+        if update < total_iters:
+            with timer("Time/env_interaction_time"):
+                obs, rollout, key = collect_rollout(obs, player_params, key)
+        # 3. refresh the player (device is done by now; transfer is the wait)
+        player_params = fabric.to_host(params)
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                pg, vl, ent = last_losses
+                aggregator.update("Loss/policy_loss", pg)
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/entropy_loss", ent)
+            metrics = aggregator.compute()
+            aggregator.reset()
+            times = timer.to_dict(reset=True)
+            steps_since = max(policy_step - last_log, 1)
+            if "Time/env_interaction_time" in times:
+                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+            if "Time/train_time" in times:
+                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+            metrics.update(times)
+            if logger is not None and metrics:
+                logger.log_metrics(metrics, policy_step)
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_player",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(agent, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,384 @@
+"""Recurrent PPO (LSTM) — coupled topology.
+
+Capability parity with the reference
+(reference: sheeprl/algos/ppo_recurrent/ppo_recurrent.py:119-524): LSTM
+policy over sequences, previous-action conditioning, recurrent-state reset
+on episode start, sequence-wise minibatching.
+
+TPU-native differences:
+* the reference splits rollouts at episode bounds and pads minibatches of
+  variable-length sequences (reference: agent.py:237-263); here episodes
+  reset INSIDE the ``lax.scan`` via the ``is_first`` mask, so training
+  consumes fixed ``(T, B)`` blocks with fully static shapes — minibatches
+  are subsets of the env axis;
+* the whole optimization phase (forward scan, GAE, epochs × env-minibatch
+  updates) is one jitted dispatch, as in the other algorithms here.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import optax
+
+from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+from sheeprl_tpu.algos.ppo.utils import actions_for_env, normalize_obs_keys, spaces_to_dims
+from sheeprl_tpu.algos.ppo_recurrent.agent import (
+    RecurrentPPOAgent,
+    build_agent,
+    one_hot_actions,
+)
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.distribution import Categorical, Normal
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+
+
+def _dist_stats(actor_out, actions, actions_dim, is_continuous):
+    """Log-prob + entropy of given actions under the actor head output."""
+    if is_continuous:
+        mean, log_std = jnp.split(actor_out, 2, axis=-1)
+        dist = Normal(mean, jnp.exp(jnp.clip(log_std, -10.0, 2.0)), event_dims=1)
+        return dist.log_prob(actions), dist.entropy()
+    lp, ent, start = 0.0, 0.0, 0
+    for i, d in enumerate(actions_dim):
+        dist = Categorical(actor_out[..., start:start + d])
+        lp = lp + dist.log_prob(actions[..., i])
+        ent = ent + dist.entropy()
+        start += d
+    return lp, ent
+
+
+def _sample(actor_out, actions_dim, is_continuous, key, greedy=False):
+    if is_continuous:
+        mean, log_std = jnp.split(actor_out, 2, axis=-1)
+        dist = Normal(mean, jnp.exp(jnp.clip(log_std, -10.0, 2.0)), event_dims=1)
+        a = dist.mode() if greedy else dist.sample(key)
+        return a, dist.log_prob(a)
+    keys = jax.random.split(key, len(actions_dim))
+    acts, lp, start = [], 0.0, 0
+    for i, d in enumerate(actions_dim):
+        dist = Categorical(actor_out[..., start:start + d])
+        a = dist.mode() if greedy else dist.sample(keys[i])
+        acts.append(a)
+        lp = lp + dist.log_prob(a)
+        start += d
+    return jnp.stack(acts, axis=-1).astype(jnp.float32), lp
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    normalize_obs_keys(cfg, obs_space)
+    actions_dim, is_continuous = spaces_to_dims(act_space)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    act_width = int(sum(actions_dim))
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
+    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    host = fabric.host_device
+    gamma = float(cfg.algo.gamma)
+    gae_lambda = float(cfg.algo.gae_lambda)
+    vf_coef = float(cfg.algo.vf_coef)
+    initial_ent_coef = float(cfg.algo.ent_coef)
+    ent_coef_v = initial_ent_coef
+    clip_coef = float(cfg.algo.clip_coef)
+    clip_vloss = bool(cfg.algo.clip_vloss)
+    normalize_adv = bool(cfg.algo.normalize_advantages)
+    base_lr = float(cfg.algo.optimizer.lr)
+    reduction = cfg.algo.loss_reduction
+    update_epochs = int(cfg.algo.update_epochs)
+
+    @jax.jit
+    def policy_step_fn(p, carry, obs, prev_actions, is_first, k):
+        carry, (actor_out, value) = agent.apply(
+            p, method=RecurrentPPOAgent.step, carry=carry, obs=obs,
+            prev_actions=prev_actions, is_first=is_first,
+        )
+        actions, logprob = _sample(actor_out, actions_dim, is_continuous, k)
+        return carry, actions, logprob, value[..., 0]
+
+    @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("env_bs", "num_minibatches"))
+    def train_phase(p, o_state, rollout, init_carry, last_values, k, ent_coef, env_bs, num_minibatches):
+        """Forward scan + GAE + epochs of env-axis minibatch updates."""
+        T, B = rollout["rewards"].shape
+
+        def fwd(p, env_idx):
+            obs = {kk: jnp.take(rollout[kk], env_idx, axis=1) for kk in mlp_keys}
+            prev_a = jnp.take(rollout["prev_actions"], env_idx, axis=1)
+            first = jnp.take(rollout["is_first"], env_idx, axis=1)
+            carry = (
+                jnp.take(init_carry[0], env_idx, axis=0),
+                jnp.take(init_carry[1], env_idx, axis=0),
+            )
+            return agent.apply(p, obs, prev_a, first, carry)
+
+        all_idx = jnp.arange(B)
+        actor_out, values = fwd(p, all_idx)
+        values = values[..., 0]
+        returns, advantages = gae(
+            rollout["rewards"], values, rollout["dones"], last_values, gamma, gae_lambda
+        )
+
+        def epoch_body(carry, key_e):
+            p, o_state = carry
+            perm = jax.random.permutation(key_e, B)
+            pad = num_minibatches * env_bs - B
+            perm = jnp.concatenate([perm, perm[: max(pad, 0)]]) if pad > 0 else perm
+
+            def mb_body(i, carry2):
+                p, o_state, _ = carry2
+                env_idx = jax.lax.dynamic_slice(perm, (i * env_bs,), (env_bs,))
+
+                def loss_of(p_):
+                    a_out, new_values = fwd(p_, env_idx)
+                    acts = jnp.take(rollout["actions"], env_idx, axis=1)
+                    lp, ent = _dist_stats(a_out, acts, actions_dim, is_continuous)
+                    adv = jnp.take(advantages, env_idx, axis=1)
+                    if normalize_adv:
+                        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                    old_lp = jnp.take(rollout["logprobs"], env_idx, axis=1)
+                    ret = jnp.take(returns, env_idx, axis=1)
+                    old_v = jnp.take(values, env_idx, axis=1)
+                    pg = policy_loss(lp, old_lp, adv, clip_coef, reduction)
+                    vl = value_loss(new_values[..., 0], old_v, ret, clip_coef, clip_vloss, reduction)
+                    el = entropy_loss(ent, reduction)
+                    return pg + vf_coef * vl + ent_coef * el, (pg, vl, el)
+
+                (_, (pg, vl, el)), grads = jax.value_and_grad(loss_of, has_aux=True)(p)
+                updates, o_state = optimizer.update(grads, o_state, p)
+                p = optax.apply_updates(p, updates)
+                return p, o_state, (pg, vl, el)
+
+            p, o_state, losses = jax.lax.fori_loop(
+                0, num_minibatches, mb_body,
+                (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))),
+            )
+            return (p, o_state), losses
+
+        (p, o_state), losses = jax.lax.scan(epoch_body, (p, o_state), jax.random.split(k, update_epochs))
+        return p, o_state, jax.tree.map(lambda x: x[-1], losses)
+
+    # ---------------- counters ----------------------------------------------
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_iter = num_envs * rollout_steps
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+
+    rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=mlp_keys)
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    prev_actions = np.zeros((num_envs, act_width), np.float32)
+    is_first = np.ones((num_envs, 1), np.float32)
+    carry_np = (
+        np.zeros((num_envs, cfg.algo.rnn.lstm.hidden_size), np.float32),
+        np.zeros((num_envs, cfg.algo.rnn.lstm.hidden_size), np.float32),
+    )
+    player_params = fabric.to_host(params)
+    last_losses = None
+
+    env_bs = max(1, min(num_envs, (int(cfg.algo.per_rank_batch_size) * fabric.world_size) // rollout_steps))
+    num_minibatches = -(-num_envs // env_bs)
+
+    for update in range(start_iter, total_iters + 1):
+        init_carry = (carry_np[0].copy(), carry_np[1].copy())
+        with timer("Time/env_interaction_time"):
+            with jax.default_device(host):
+                for _ in range(rollout_steps):
+                    policy_step += num_envs
+                    dev_obs = {
+                        k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+                        for k in mlp_keys
+                    }
+                    key, sk = jax.random.split(key)
+                    carry, actions, logprobs, _ = policy_step_fn(
+                        player_params,
+                        (jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                        dev_obs,
+                        jnp.asarray(prev_actions),
+                        jnp.asarray(is_first),
+                        sk,
+                    )
+                    carry_np = (np.asarray(carry[0]), np.asarray(carry[1]))
+                    actions_np = np.asarray(actions)
+                    next_obs, rewards, terminated, truncated, info = envs.step(
+                        actions_for_env(actions_np, act_space)
+                    )
+                    dones = np.logical_or(terminated, truncated).astype(np.float32)
+                    rewards = np.asarray(rewards, np.float32)
+
+                    # truncation bootstrap (reference: ppo.py:287-306) using the
+                    # post-step recurrent state; padded to the full env batch
+                    if np.any(truncated):
+                        final_obs = final_obs_rows(info, np.nonzero(truncated)[0], mlp_keys)
+                        if final_obs is not None:
+                            padded = {
+                                k: np.asarray(next_obs[k], np.float32).reshape(num_envs, -1).copy()
+                                for k in mlp_keys
+                            }
+                            for k in mlp_keys:
+                                padded[k][truncated] = np.asarray(final_obs[k], np.float32).reshape(
+                                    int(truncated.sum()), -1
+                                )
+                            prev_a_boot = np.asarray(
+                                one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
+                            )
+                            _, (_, v_boot) = agent.apply(
+                                player_params, method=RecurrentPPOAgent.step,
+                                carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                                obs={k: jnp.asarray(padded[k]) for k in mlp_keys},
+                                prev_actions=jnp.asarray(prev_a_boot),
+                                is_first=jnp.zeros((num_envs, 1)),
+                            )
+                            v_boot = np.asarray(v_boot)[..., 0]
+                            rewards[truncated] += gamma * v_boot[truncated]
+
+                    step = {
+                        "actions": actions_np[None],
+                        "logprobs": np.asarray(logprobs)[None],
+                        "rewards": rewards[None],
+                        "dones": dones[None],
+                        "is_first": is_first[None, :, 0],
+                        "prev_actions": prev_actions[None],
+                    }
+                    for k in mlp_keys:
+                        step[k] = np.asarray(obs[k], np.float32).reshape(1, num_envs, -1)
+                    rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step.items()})
+
+                    obs = next_obs
+                    prev_actions = np.array(
+                        one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
+                    )
+                    prev_actions[dones.astype(bool)] = 0.0
+                    is_first = dones[:, None]
+                    for ep_ret, ep_len in episode_stats(info):
+                        aggregator.update("Rewards/rew_avg", ep_ret)
+                        aggregator.update("Game/ep_len_avg", ep_len)
+
+        with timer("Time/train_time"):
+            local = rb.buffer
+            rollout = {k: jnp.asarray(np.asarray(local[k], np.float32)) for k in mlp_keys}
+            rollout["actions"] = jnp.asarray(local["actions"])
+            rollout["prev_actions"] = jnp.asarray(local["prev_actions"])
+            rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
+            rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
+            rollout["dones"] = jnp.asarray(local["dones"][..., 0])
+            rollout["is_first"] = jnp.asarray(local["is_first"])  # (T, B, 1)
+            rollout = fabric.replicate(rollout)
+
+            # bootstrap values for the state after the rollout
+            dev_obs = {
+                k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1)) for k in mlp_keys
+            }
+            _, (_, last_v) = agent.apply(
+                player_params, method=RecurrentPPOAgent.step,
+                carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                obs=dev_obs, prev_actions=jnp.asarray(prev_actions),
+                is_first=jnp.asarray(is_first),
+            )
+            key, tk = jax.random.split(key)
+            params, opt_state, last_losses = train_phase(
+                params, opt_state, rollout,
+                fabric.replicate((jnp.asarray(init_carry[0]), jnp.asarray(init_carry[1]))),
+                fabric.replicate(jnp.asarray(np.asarray(last_v)[..., 0])),
+                tk, jnp.float32(ent_coef_v), env_bs=env_bs, num_minibatches=num_minibatches,
+            )
+            player_params = fabric.to_host(params)
+
+        if cfg.algo.anneal_lr:
+            opt_state = set_learning_rate(
+                opt_state,
+                polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters),
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef_v = polynomial_decay(
+                update, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters
+            )
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                pg, vl, el = last_losses
+                aggregator.update("Loss/policy_loss", pg)
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/entropy_loss", el)
+            metrics = aggregator.compute()
+            aggregator.reset()
+            times = timer.to_dict(reset=True)
+            steps_since = max(policy_step - last_log, 1)
+            if "Time/env_interaction_time" in times:
+                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+            if "Time/train_time" in times:
+                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+            metrics.update(times)
+            if logger is not None and metrics:
+                logger.log_metrics(metrics, policy_step)
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        from sheeprl_tpu.algos.ppo_recurrent.utils import test
+
+        test(agent, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

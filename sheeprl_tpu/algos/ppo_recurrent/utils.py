@@ -1,0 +1,61 @@
+"""Recurrent PPO utilities (reference: sheeprl/algos/ppo_recurrent/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/entropy_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def test(agent: Any, params: Any, cfg: Any, log_dir: str, logger: Any = None, greedy: bool = True) -> float:
+    from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
+    from sheeprl_tpu.algos.ppo_recurrent.agent import RecurrentPPOAgent, one_hot_actions
+    from sheeprl_tpu.algos.ppo_recurrent.ppo_recurrent import _sample
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, run_name=log_dir, prefix="test")()
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    actions_dim, is_continuous = spaces_to_dims(env.action_space)
+    act_width = int(sum(actions_dim))
+    hidden = cfg.algo.rnn.lstm.hidden_size
+
+    @jax.jit
+    def step(p, carry, o, prev_a, first, k):
+        carry, (actor_out, _) = agent.apply(
+            p, method=RecurrentPPOAgent.step, carry=carry, obs=o,
+            prev_actions=prev_a, is_first=first,
+        )
+        a, _ = _sample(actor_out, actions_dim, is_continuous, k, greedy=greedy)
+        return carry, a
+
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    carry = (jnp.zeros((1, hidden)), jnp.zeros((1, hidden)))
+    prev_a = jnp.zeros((1, act_width))
+    first = jnp.ones((1, 1))
+    done, cum_reward = False, 0.0
+    while not done:
+        o = {k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(1, -1)) for k in mlp_keys}
+        key, sk = jax.random.split(key)
+        carry, a = step(params, carry, o, prev_a, first, sk)
+        a_np = np.asarray(a)
+        obs, reward, terminated, truncated, _ = env.step(actions_for_env(a_np, env.action_space)[0])
+        done = bool(terminated or truncated)
+        prev_a = one_hot_actions(a, actions_dim, is_continuous)
+        first = jnp.zeros((1, 1))
+        cum_reward += float(reward)
+    env.close()
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
+    return cum_reward

@@ -1,0 +1,19 @@
+"""SAC losses (reference: sheeprl/algos/sac/loss.py)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def critic_loss(qs: jax.Array, target: jax.Array) -> jax.Array:
+    """Sum of per-critic MSEs; ``qs`` is (N, B), ``target`` (B,)."""
+    return 0.5 * ((qs - target[None, :]) ** 2).mean(axis=1).sum()
+
+
+def actor_loss(alpha: jax.Array, log_prob: jax.Array, min_q: jax.Array) -> jax.Array:
+    return (alpha * log_prob - min_q).mean()
+
+
+def alpha_loss(log_alpha: jax.Array, log_prob: jax.Array, target_entropy: float) -> jax.Array:
+    return -(jnp.exp(log_alpha) * jax.lax.stop_gradient(log_prob + target_entropy)).mean()

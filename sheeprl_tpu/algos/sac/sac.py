@@ -1,0 +1,331 @@
+"""SAC, coupled topology (off-policy path of the build plan, SURVEY.md §7.4).
+
+Capability parity with the reference train script
+(reference: sheeprl/algos/sac/sac.py:81-427): uniform replay, twin-Q with
+EMA targets, squashed-Gaussian actor, automatic temperature tuning with the
+α-gradient synchronized across the world (reference: sac.py:68-73 — here the
+mean over the globally-sharded batch does it), ``Ratio``-governed gradient
+steps per env step, learning_starts prefill with random actions.
+
+TPU-native structure:
+* host player selects actions (CPU copy of actor params, refreshed after
+  each train dispatch);
+* each iteration's gradient steps run as ONE jitted dispatch — the replay
+  batch block for ALL steps of the window is sampled host-side in one call
+  (n_samples × batch, the reference's own bulk pattern,
+  reference: dreamer_v3.py:664-671) and scanned over on device;
+* actions live in the actor's tanh space [-1, 1] inside the framework and
+  are rescaled to env bounds only at the env boundary.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.sac.agent import build_agent, ema_update, sample_action
+from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    act_space = envs.single_action_space
+    if not isinstance(act_space, gym.spaces.Box):
+        raise ValueError("SAC supports continuous (Box) action spaces only, like the reference")
+    obs_space = envs.single_observation_space
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    for k in mlp_keys:
+        if k not in obs_space.spaces:
+            raise ValueError(f"mlp key '{k}' not in observation space {list(obs_space.spaces)}")
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(act_space.shape))
+    act_low = np.asarray(act_space.low, np.float32)
+    act_high = np.asarray(act_space.high, np.float32)
+
+    def to_env_actions(a: np.ndarray) -> np.ndarray:
+        return act_low + (a + 1.0) * 0.5 * (act_high - act_low)
+
+    # ---------------- agent -------------------------------------------------
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    actor, critic, params = build_agent(fabric, act_dim, cfg, obs_dim, state.get("agent"))
+
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer)
+    alpha_opt = build_optimizer(cfg.algo.alpha.optimizer)
+    opt_state = fabric.replicate(
+        state.get("opt_state")
+        or {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    aggregator = MetricAggregator(
+        cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {}
+    )
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    host = fabric.host_device
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    target_entropy = -float(act_dim)
+    target_freq = int(cfg.algo.critic.target_network_frequency)
+
+    @partial(jax.jit, static_argnames=("greedy",))
+    def act_fn(p, obs, k, greedy=False):
+        a, _ = sample_action(actor, p, obs, k, greedy=greedy)
+        return a
+
+    player_params = fabric.to_host(params["actor"])
+
+    # ---------------- single-dispatch multi-update train phase --------------
+    def one_update(carry, batch_and_key):
+        p, o_state, step_idx = carry
+        batch, k = batch_and_key
+        k_next, k_pi = jax.random.split(k)
+        alpha = jnp.exp(p["log_alpha"])
+
+        # -- critic
+        next_a, next_lp = sample_action(actor, p["actor"], batch["next_obs"], k_next)
+        target_qs = critic.apply(p["target_critic"], batch["next_obs"], next_a)
+        target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
+        y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * target_v
+
+        def c_loss(cp):
+            qs = critic.apply(cp, batch["obs"], batch["actions"])
+            return critic_loss(qs, jax.lax.stop_gradient(y))
+
+        vl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+
+        # -- actor
+        def a_loss(ap):
+            a, lp = sample_action(actor, ap, batch["obs"], k_pi)
+            qs = critic.apply(p["critic"], batch["obs"], a)
+            return actor_loss(alpha, lp, jnp.min(qs, axis=0)), lp
+
+        (pl, lp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+        # -- temperature
+        def t_loss(la):
+            return alpha_loss(la, lp, target_entropy)
+
+        al, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"])
+        t_updates, new_t_opt = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+        p = {**p, "log_alpha": p["log_alpha"] + t_updates}
+
+        # -- EMA target (every target_network_frequency updates,
+        #    reference: sac.py target update cadence)
+        do_ema = (step_idx % target_freq) == 0
+        new_target = ema_update(p["target_critic"], p["critic"], tau)
+        p = {
+            **p,
+            "target_critic": jax.tree.map(
+                lambda n, o: jnp.where(do_ema, n, o), new_target, p["target_critic"]
+            ),
+        }
+        o_state = {"actor": new_a_opt, "critic": new_c_opt, "alpha": new_t_opt}
+        return (p, o_state, step_idx + 1), (vl, pl, al)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, batches, k, step0):
+        """``batches``: dict of (U, batch, ...) stacked update blocks."""
+        U = batches["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), losses = jax.lax.scan(
+            one_update, (p, o_state, step0), (batches, keys)
+        )
+        return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
+
+    # ---------------- counters ----------------------------------------------
+    policy_steps_per_iter = num_envs
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    learning_starts = int(cfg.algo.learning_starts) // policy_steps_per_iter if not cfg.dry_run else 0
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    grad_step_counter = int(state.get("grad_steps", 0))
+    if state:
+        learning_starts += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    rb = ReplayBuffer(
+        int(cfg.buffer.size) // num_envs,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
+
+    # ---------------- main loop ---------------------------------------------
+    obs, _ = envs.reset(seed=cfg.seed)
+    obs_vec = np.concatenate(
+        [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+    )
+    last_losses = None
+
+    for update in range(start_iter, total_iters + 1):
+        policy_step += num_envs
+        with timer("Time/env_interaction_time"):
+            if update <= learning_starts and not state:
+                env_actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                span = act_high - act_low
+                actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
+            else:
+                with jax.default_device(host):
+                    key, sk = jax.random.split(key)
+                    actions = np.asarray(act_fn(player_params, jnp.asarray(obs_vec), sk))
+                env_actions = to_env_actions(actions)
+            next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+            dones = np.logical_or(terminated, truncated).astype(np.float32)
+            rewards = np.asarray(rewards, np.float32)
+
+            next_vec = np.concatenate(
+                [np.asarray(next_obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
+            )
+            # real next obs for done envs (autoreset replaced them)
+            store_next = next_vec
+            done_idx = np.nonzero(dones)[0]
+            if done_idx.size:
+                final = final_obs_rows(info, done_idx, mlp_keys)
+                if final is not None:
+                    store_next = next_vec.copy()
+                    store_next[done_idx] = np.concatenate(
+                        [np.asarray(final[k], np.float32).reshape(done_idx.size, -1) for k in mlp_keys],
+                        axis=-1,
+                    )
+
+            rb.add(
+                {
+                    "obs": obs_vec[None],
+                    "next_obs": store_next[None],
+                    "actions": actions[None].astype(np.float32),
+                    "rewards": rewards[None, :, None],
+                    "dones": dones[None, :, None],
+                }
+            )
+            obs_vec = next_vec
+            for ep_ret, ep_len in episode_stats(info):
+                aggregator.update("Rewards/rew_avg", ep_ret)
+                aggregator.update("Game/ep_len_avg", ep_len)
+
+        # ---------------- training ------------------------------------------
+        if update >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(
+                        batch_size, n_samples=per_rank_gradient_steps
+                    )  # (U, batch, *) block in one host call
+                    batches = {
+                        "obs": jnp.asarray(sample["obs"]),
+                        "next_obs": jnp.asarray(sample["next_obs"]),
+                        "actions": jnp.asarray(sample["actions"]),
+                        "rewards": jnp.asarray(sample["rewards"][..., 0]),
+                        "dones": jnp.asarray(sample["dones"][..., 0]),
+                    }
+                    batches = fabric.shard_batch(batches, axis=1)
+                    key, tk = jax.random.split(key)
+                    params, opt_state, last_losses = train_phase(
+                        params, opt_state, batches, tk, jnp.int32(grad_step_counter)
+                    )
+                    grad_step_counter += per_rank_gradient_steps
+                    player_params = fabric.to_host(params["actor"])
+
+        # ---------------- logging -------------------------------------------
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                vl, pl, al = last_losses
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/policy_loss", pl)
+                aggregator.update("Loss/alpha_loss", al)
+            metrics = aggregator.compute()
+            aggregator.reset()
+            times = timer.to_dict(reset=True)
+            steps_since = max(policy_step - last_log, 1)
+            if "Time/env_interaction_time" in times:
+                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+            if "Time/train_time" in times:
+                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+            metrics["Params/replay_ratio"] = grad_step_counter * fabric.world_size / max(policy_step, 1)
+            metrics.update(times)
+            if logger is not None and metrics:
+                logger.log_metrics(metrics, policy_step)
+            last_log = policy_step
+
+        # ---------------- checkpoint ----------------------------------------
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "ratio": ratio.state_dict(),
+                "grad_steps": grad_step_counter,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        test(actor, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,62 @@
+"""SAC support utilities (reference: sheeprl/algos/sac/utils.py:1-103)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+}
+MODELS_TO_REGISTER = {"agent"}
+
+
+def prepare_obs(obs: Dict[str, np.ndarray], mlp_keys: Sequence[str]) -> jax.Array:
+    """Concatenate the vector observation keys into one float32 matrix
+    (SAC is vector-obs; pixels are SAC-AE's job)."""
+    import jax.numpy as jnp
+
+    parts = [np.asarray(obs[k], np.float32).reshape(np.asarray(obs[k]).shape[0], -1) for k in mlp_keys]
+    return jnp.asarray(np.concatenate(parts, axis=-1))
+
+
+def test(actor: Any, params: Any, cfg: Any, log_dir: str, logger: Any = None, greedy: bool = True) -> float:
+    """Greedy evaluation episode (reference: sheeprl/algos/sac/utils.py:test)."""
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.sac.agent import sample_action
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, run_name=log_dir, prefix="test")()
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, o, k):
+        a, _ = sample_action(actor, p, o, k, greedy=greedy)
+        return a
+
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    done, cum_reward = False, 0.0
+    low = np.asarray(env.action_space.low, np.float32)
+    high = np.asarray(env.action_space.high, np.float32)
+    while not done:
+        batched = {k: np.asarray(v)[None] for k, v in obs.items()}
+        o = prepare_obs(batched, mlp_keys)
+        key, sk = jax.random.split(key)
+        action = np.asarray(act(params, o, sk))[0]
+        # actor outputs [-1, 1]; rescale to the env's bounds
+        scaled = low + (action + 1.0) * 0.5 * (high - low)
+        obs, reward, terminated, truncated, _ = env.step(scaled)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
+    return cum_reward

@@ -171,8 +171,21 @@ class Fabric:
         return jax.local_devices(backend="cpu")[0]
 
     def to_host(self, tree: Any) -> Any:
-        """Copy a pytree to the host CPU device (one bulk transfer)."""
-        return jax.device_put(tree, self.host_device)
+        """Copy a pytree to the host CPU device (one bulk transfer).
+
+        ALWAYS a real copy: when the source already lives on the host device
+        (CPU runs), ``device_put`` would be a no-op alias — and the training
+        step donates its params input, which would invalidate the player's
+        copy mid-rollout.  ``.copy()`` breaks the alias.
+        """
+        host = self.host_device
+
+        def put(x: Any) -> Any:
+            if isinstance(x, jax.Array) and x.committed and set(x.devices()) == {host}:
+                return x.copy()
+            return jax.device_put(x, host)
+
+        return jax.tree.map(put, tree)
 
     # -- sharding helpers --------------------------------------------------
     def sharding(self, *spec: Any) -> NamedSharding:

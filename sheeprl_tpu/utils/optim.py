@@ -83,6 +83,18 @@ def build_optimizer(
         base = optax.inject_hyperparams(optax.sgd)(
             learning_rate=lr, momentum=float(optim_cfg.get("momentum", 0.0))
         )
+    elif name == "rmsprop":
+        momentum = float(optim_cfg.get("momentum", 0.0))
+        base = optax.inject_hyperparams(optax.rmsprop)(
+            learning_rate=lr,
+            decay=float(optim_cfg.get("alpha", 0.99)),
+            eps=float(optim_cfg.get("eps", 1e-8)),
+            # torch semantics: eps OUTSIDE the sqrt (the TF-style variant is
+            # the separate rmsprop_tf above)
+            eps_in_sqrt=False,
+            momentum=momentum if momentum > 0 else None,
+            centered=bool(optim_cfg.get("centered", False)),
+        )
     elif name == "rmsprop_tf":
         base = optax.inject_hyperparams(rmsprop_tf)(
             learning_rate=lr,

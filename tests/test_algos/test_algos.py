@@ -132,3 +132,92 @@ def test_evaluation_cli(tmp_path, monkeypatch):
 
     ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
     evaluation([f"checkpoint_path={ckpts[0]}", "env.capture_video=False"])
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_a2c_dry_run(tmp_path, devices, env_id):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=a2c",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+        ],
+        devices=devices,
+    )
+    run(args)
+
+
+def test_sac_dry_run(tmp_path, devices):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+            "buffer.size=64",
+        ],
+        devices=devices,
+    )
+    run(args)
+
+
+def test_sac_rejects_discrete(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=sac",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.per_rank_batch_size=8",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=64",
+        ],
+    )
+    with pytest.raises(ValueError, match="continuous"):
+        run(args)
+
+
+def test_ppo_decoupled_dry_run(tmp_path, devices):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=ppo_decoupled",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+        ],
+        devices=devices,
+    )
+    run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "continuous_dummy"])
+def test_ppo_recurrent_dry_run(tmp_path, env_id):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=ppo_recurrent",
+            "env=dummy",
+            f"env.id={env_id}",
+            "env.mask_velocities=False",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+        ],
+    )
+    run(args)
