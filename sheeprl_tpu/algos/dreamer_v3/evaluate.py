@@ -1,0 +1,66 @@
+"""DreamerV3 evaluation entrypoint (reference: sheeprl/algos/dreamer_v3/evaluate.py)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import test
+from sheeprl_tpu.algos.ppo.utils import spaces_to_dims
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="dreamer_v3")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    env = make_env(cfg, cfg.seed, 0)()
+    actions_dim, is_continuous = spaces_to_dims(env.action_space)
+    obs_space = env.observation_space
+    env.close()
+    world_model, actor, critic, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, state["agent"]
+    )
+    act_width = int(sum(actions_dim))
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    stoch_flat = world_model.stoch_flat
+    host_params = fabric.to_host({"world_model": params["world_model"], "actor": params["actor"]})
+
+    @partial(jax.jit, static_argnames=("greedy",))
+    def _step(p, carry, obs, k, greedy=True):
+        h, z, prev_a = carry
+        k_repr, k_act = jax.random.split(k)
+        embed = world_model.apply(p["world_model"], obs, method=WorldModel.encode)
+        h, z, _, _ = world_model.apply(
+            p["world_model"], h, z, prev_a, embed, jnp.zeros((h.shape[0], 1)), k_repr,
+            method=WorldModel.dynamic,
+        )
+        latent = jnp.concatenate([z, h], -1)
+        action = actor.sample(actor.apply(p["actor"], latent), k_act, greedy=greedy)
+        return (h, z, action), action
+
+    def player_step_fn(p, carry, obs, k, greedy):
+        if carry is None:
+            carry = (
+                jnp.zeros((1, rec_size)),
+                jnp.zeros((1, stoch_flat)),
+                jnp.zeros((1, act_width)),
+            )
+        carry, action = _step(p, carry, obs, k, greedy=greedy)
+        a = np.asarray(action)
+        if not is_continuous:
+            idx, start = [], 0
+            for d in actions_dim:
+                idx.append(a[..., start:start + d].argmax(-1))
+                start += d
+            a = np.stack(idx, axis=-1).astype(np.float32)
+        return carry, a
+
+    test(player_step_fn, host_params, cfg, log_dir, logger)

@@ -1,0 +1,55 @@
+"""DreamerV3 world-model loss (reference: sheeprl/algos/dreamer_v3/loss.py:9-88)."""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import OneHotCategorical, kl_categorical
+
+
+def world_model_loss(
+    obs_log_probs: Dict[str, jax.Array],
+    reward_log_prob: jax.Array,
+    continue_log_prob: jax.Array,
+    posterior_logits: jax.Array,
+    prior_logits: jax.Array,
+    kl_dynamic: float = 0.5,
+    kl_representation: float = 0.1,
+    kl_free_nats: float = 1.0,
+    kl_regularizer: float = 1.0,
+    continue_scale_factor: float = 1.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Eq. 5 of the DreamerV3 paper: reconstruction + reward + continue NLL
+    plus free-nats-clipped balanced KL.
+
+    All *_log_prob arrays are (T, B); logits are (T, B, stoch, discrete).
+    KL is summed over the stochastic axis (Independent(·, 1) semantics).
+    """
+    observation_loss = -sum(obs_log_probs.values())
+    reward_loss = -reward_log_prob
+    continue_loss = -continue_scale_factor * continue_log_prob
+
+    post = OneHotCategorical(posterior_logits)
+    post_sg = OneHotCategorical(jax.lax.stop_gradient(posterior_logits))
+    prior = OneHotCategorical(prior_logits)
+    prior_sg = OneHotCategorical(jax.lax.stop_gradient(prior_logits))
+
+    kl = kl_categorical(post_sg, prior).sum(-1)  # sum over stochastic axis
+    dyn_loss = kl_dynamic * jnp.maximum(kl, kl_free_nats)
+    repr_loss = kl_representation * jnp.maximum(
+        kl_categorical(post, prior_sg).sum(-1), kl_free_nats
+    )
+    kl_loss = dyn_loss + repr_loss
+
+    total = jnp.mean(kl_regularizer * kl_loss + observation_loss + reward_loss + continue_loss)
+    aux = {
+        "kl": kl.mean(),
+        "kl_loss": kl_loss.mean(),
+        "observation_loss": observation_loss.mean(),
+        "reward_loss": reward_loss.mean(),
+        "continue_loss": continue_loss.mean(),
+    }
+    return total, aux

@@ -47,7 +47,8 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
+from sheeprl_tpu.utils.utils import polynomial_decay
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import gae, save_configs
@@ -254,6 +255,21 @@ def main(fabric: Any, cfg: Any) -> None:
                 obs, rollout, key = collect_rollout(obs, player_params, key)
         # 3. refresh the player (device is done by now; transfer is the wait)
         player_params = fabric.to_host(params)
+
+        # schedules (reference: ppo_decoupled.py:586-594)
+        if cfg.algo.anneal_lr:
+            opt_state = set_learning_rate(
+                opt_state,
+                polynomial_decay(update, initial=float(cfg.algo.optimizer.lr), final=0.0, max_decay_steps=total_iters),
+            )
+        if cfg.algo.anneal_clip_coef:
+            clip_coef_v = polynomial_decay(
+                update, initial=float(cfg.algo.clip_coef), final=0.0, max_decay_steps=total_iters
+            )
+        if cfg.algo.anneal_ent_coef:
+            ent_coef_v = polynomial_decay(
+                update, initial=float(cfg.algo.ent_coef), final=0.0, max_decay_steps=total_iters
+            )
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run

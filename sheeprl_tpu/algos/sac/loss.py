@@ -16,4 +16,7 @@ def actor_loss(alpha: jax.Array, log_prob: jax.Array, min_q: jax.Array) -> jax.A
 
 
 def alpha_loss(log_alpha: jax.Array, log_prob: jax.Array, target_entropy: float) -> jax.Array:
-    return -(jnp.exp(log_alpha) * jax.lax.stop_gradient(log_prob + target_entropy)).mean()
+    """Eq. 17 temperature objective: gradient w.r.t. log_alpha is the mean
+    entropy error, independent of alpha's current magnitude
+    (reference: sheeprl/algos/sac/loss.py:23-26)."""
+    return -(log_alpha * jax.lax.stop_gradient(log_prob + target_entropy)).mean()

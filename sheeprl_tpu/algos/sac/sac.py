@@ -124,7 +124,9 @@ def main(fabric: Any, cfg: Any) -> None:
         next_a, next_lp = sample_action(actor, p["actor"], batch["next_obs"], k_next)
         target_qs = critic.apply(p["target_critic"], batch["next_obs"], next_a)
         target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
-        y = batch["rewards"] + gamma * (1.0 - batch["dones"]) * target_v
+        # bootstrap THROUGH time-limit truncation: only true termination cuts
+        # the return (reference: sac.py:46 uses data["terminated"])
+        y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * target_v
 
         def c_loss(cp):
             qs = critic.apply(cp, batch["obs"], batch["actions"])
@@ -206,9 +208,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # ---------------- main loop ---------------------------------------------
     obs, _ = envs.reset(seed=cfg.seed)
-    obs_vec = np.concatenate(
-        [np.asarray(obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-    )
+    obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
 
     for update in range(start_iter, total_iters + 1):
@@ -227,9 +227,7 @@ def main(fabric: Any, cfg: Any) -> None:
             dones = np.logical_or(terminated, truncated).astype(np.float32)
             rewards = np.asarray(rewards, np.float32)
 
-            next_vec = np.concatenate(
-                [np.asarray(next_obs[k], np.float32).reshape(num_envs, -1) for k in mlp_keys], axis=-1
-            )
+            next_vec = np.asarray(prepare_obs(next_obs, mlp_keys))
             # real next obs for done envs (autoreset replaced them)
             store_next = next_vec
             done_idx = np.nonzero(dones)[0]
@@ -248,7 +246,7 @@ def main(fabric: Any, cfg: Any) -> None:
                     "next_obs": store_next[None],
                     "actions": actions[None].astype(np.float32),
                     "rewards": rewards[None, :, None],
-                    "dones": dones[None, :, None],
+                    "terminated": terminated.astype(np.float32)[None, :, None],
                 }
             )
             obs_vec = next_vec
@@ -269,7 +267,7 @@ def main(fabric: Any, cfg: Any) -> None:
                         "next_obs": jnp.asarray(sample["next_obs"]),
                         "actions": jnp.asarray(sample["actions"]),
                         "rewards": jnp.asarray(sample["rewards"][..., 0]),
-                        "dones": jnp.asarray(sample["dones"][..., 0]),
+                        "terminated": jnp.asarray(sample["terminated"][..., 0]),
                     }
                     batches = fabric.shard_batch(batches, axis=1)
                     key, tk = jax.random.split(key)

@@ -221,3 +221,34 @@ def test_ppo_recurrent_dry_run(tmp_path, env_id):
         ],
     )
     run(args)
+
+
+@pytest.mark.parametrize("env_id", ["discrete_dummy", "multidiscrete_dummy", "continuous_dummy"])
+def test_dreamer_v3_dry_run(tmp_path, env_id):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo=dreamer_v3_XS",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=8",
+            "algo.learning_starts=0",
+            "algo.replay_ratio=1",
+            "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.dense_units=16",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            "env.screen_size=64",
+            "env.max_episode_steps=20",
+            "buffer.size=200",
+        ],
+    )
+    run(args)
