@@ -1,0 +1,200 @@
+"""DreamerV1 agent (flax).
+
+Capability parity with the reference (reference: sheeprl/algos/dreamer_v1/
+agent.py:1-547): RSSM with CONTINUOUS Gaussian latents (mean + softplus
+std + min_std), plain-KL world model, Gaussian observation/reward heads,
+value network, dynamics-backprop actor.  Shares the encoder/decoder/
+recurrent-cell family with the V2/V3 implementation, configured without
+LayerNorm stages (the reference uses plain conv/dense + ELU).
+
+The module exposes the same method surface as the discrete ``WorldModel``
+(``encode``/``dynamic``/``imagination``/``decode``/heads) so the shared
+Dreamer family loop and player drive it unchanged; ``dynamic`` returns the
+posterior/prior (mean‖std) stacked where V3 returns categorical logits.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    Critic,
+    Decoder,
+    DreamerMLP,
+    Encoder,
+    RecurrentModel,
+)
+
+
+class GaussianWorldModel(nn.Module):
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_shapes: Dict[str, Tuple[int, int, int]]
+    mlp_shapes: Dict[str, int]
+    actions_dim: Tuple[int, ...]
+    cnn_mult: int = 32
+    dense_units: int = 400
+    mlp_layers: int = 4
+    recurrent_size: int = 200
+    hidden_size: int = 200
+    stochastic_size: int = 30
+    min_std: float = 0.1
+    act: str = "elu"
+    dtype: Any = jnp.float32
+
+    @property
+    def stoch_flat(self) -> int:
+        return self.stochastic_size
+
+    def setup(self) -> None:
+        self.encoder = Encoder(
+            cnn_keys=self.cnn_keys, mlp_keys=self.mlp_keys, cnn_mult=self.cnn_mult,
+            mlp_units=self.dense_units, mlp_layers=self.mlp_layers, act=self.act,
+            layer_norm=False, symlog_inputs=False, dtype=self.dtype, name="encoder",
+        )
+        self.recurrent_model = RecurrentModel(
+            recurrent_size=self.recurrent_size, dense_units=self.dense_units,
+            dtype=self.dtype, name="recurrent_model",
+        )
+        self.representation_model = DreamerMLP(
+            units=self.hidden_size, layers=1, output_dim=2 * self.stochastic_size,
+            act=self.act, layer_norm=False, dtype=self.dtype, name="representation_model",
+        )
+        self.transition_model = DreamerMLP(
+            units=self.hidden_size, layers=1, output_dim=2 * self.stochastic_size,
+            act=self.act, layer_norm=False, dtype=self.dtype, name="transition_model",
+        )
+        self.observation_model = Decoder(
+            cnn_keys=self.cnn_keys, mlp_keys=self.mlp_keys, cnn_shapes=self.cnn_shapes,
+            mlp_shapes=self.mlp_shapes, cnn_mult=self.cnn_mult, mlp_units=self.dense_units,
+            mlp_layers=self.mlp_layers, act=self.act, layer_norm=False,
+            dtype=self.dtype, name="observation_model",
+        )
+        self.reward_model = DreamerMLP(
+            units=self.dense_units, layers=self.mlp_layers, output_dim=1,
+            act=self.act, layer_norm=False, dtype=self.dtype, name="reward_model",
+        )
+        self.continue_model = DreamerMLP(
+            units=self.dense_units, layers=self.mlp_layers, output_dim=1,
+            act=self.act, layer_norm=False, dtype=self.dtype, name="continue_model",
+        )
+
+    # -- helpers -------------------------------------------------------------
+    def _moments(self, raw: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        mean, std_raw = jnp.split(raw, 2, axis=-1)
+        std = jax.nn.softplus(std_raw) + self.min_std
+        return mean, std
+
+    def encode(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        return self.encoder(obs)
+
+    def dynamic(self, prev_h, prev_z, prev_action, embed, is_first, key):
+        """Posterior step: returns (h, z, post_moments, prior_moments) where
+        moments = mean‖std stacked on the last axis."""
+        mask = 1.0 - is_first
+        prev_h = prev_h * mask
+        prev_z = prev_z * mask
+        prev_action = prev_action * mask
+        h = self.recurrent_model(prev_h, jnp.concatenate([prev_z, prev_action], -1))
+        h = h.astype(jnp.float32)
+        prior_mean, prior_std = self._moments(self.transition_model(h))
+        post_mean, post_std = self._moments(
+            self.representation_model(jnp.concatenate([h, embed], -1))
+        )
+        z = post_mean + post_std * jax.random.normal(key, post_mean.shape)
+        return (
+            h,
+            z,
+            jnp.concatenate([post_mean, post_std], -1),
+            jnp.concatenate([prior_mean, prior_std], -1),
+        )
+
+    def imagination(self, prev_h, prev_z, action, key):
+        h = self.recurrent_model(prev_h, jnp.concatenate([prev_z, action], -1))
+        h = h.astype(jnp.float32)
+        prior_mean, prior_std = self._moments(self.transition_model(h))
+        z = prior_mean + prior_std * jax.random.normal(key, prior_mean.shape)
+        return h, z
+
+    def decode(self, latent: jax.Array) -> Dict[str, jax.Array]:
+        return self.observation_model(latent)
+
+    def reward_logits(self, latent: jax.Array) -> jax.Array:
+        return self.reward_model(latent)
+
+    def continue_logits(self, latent: jax.Array) -> jax.Array:
+        return self.continue_model(latent)
+
+    def __call__(self, obs, prev_h, prev_z, prev_action, is_first, key):
+        embed = self.encode(obs)
+        h, z, post, prior = self.dynamic(prev_h, prev_z, prev_action, embed, is_first, key)
+        latent = jnp.concatenate([z, h], -1)
+        recon = self.decode(latent)
+        return h, z, post, prior, recon, self.reward_logits(latent), self.continue_logits(latent)
+
+
+def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    wm_cfg = cfg.algo.world_model
+    cnn_shapes = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        if len(shape) == 4:
+            shape = (shape[1], shape[2], shape[0] * shape[3])
+        cnn_shapes[k] = tuple(shape)
+    mlp_shapes = {k: int(np.prod(obs_space[k].shape)) for k in mlp_keys}
+    dtype = fabric.precision.compute_dtype
+
+    world_model = GaussianWorldModel(
+        cnn_keys=cnn_keys, mlp_keys=mlp_keys, cnn_shapes=cnn_shapes, mlp_shapes=mlp_shapes,
+        actions_dim=tuple(actions_dim),
+        cnn_mult=wm_cfg.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        recurrent_size=wm_cfg.recurrent_model.recurrent_state_size,
+        hidden_size=wm_cfg.transition_model.hidden_size,
+        stochastic_size=wm_cfg.stochastic_size,
+        min_std=float(wm_cfg.min_std),
+        act=cfg.algo.dense_act,
+        dtype=dtype,
+    )
+    actor = Actor(
+        actions_dim=tuple(actions_dim), is_continuous=is_continuous,
+        dense_units=cfg.algo.actor.dense_units, mlp_layers=cfg.algo.actor.mlp_layers,
+        act=cfg.algo.dense_act, layer_norm=False, unimix=0.0,
+        min_std=cfg.algo.actor.min_std, init_std=cfg.algo.actor.init_std,
+        action_clip=1.0, dtype=dtype,
+    )
+    critic = Critic(
+        dense_units=cfg.algo.critic.dense_units, mlp_layers=cfg.algo.critic.mlp_layers,
+        act=cfg.algo.dense_act, layer_norm=False, bins=1, dtype=dtype,
+    )
+    if state is not None:
+        return world_model, actor, critic, fabric.replicate(state)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    k_wm, k_actor, k_critic, k_s = jax.random.split(key, 4)
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *cnn_shapes[k]), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, mlp_shapes[k]), jnp.float32)
+    rec = wm_cfg.recurrent_model.recurrent_state_size
+    wm_params = world_model.init(
+        k_wm, dummy_obs, jnp.zeros((1, rec)), jnp.zeros((1, wm_cfg.stochastic_size)),
+        jnp.zeros((1, int(sum(actions_dim)))), jnp.ones((1, 1)), k_s,
+    )
+    latent = jnp.zeros((1, wm_cfg.stochastic_size + rec))
+    params = {
+        "world_model": wm_params,
+        "actor": actor.init(k_actor, latent),
+        "critic": critic.init(k_critic, latent),
+    }
+    return world_model, actor, critic, fabric.replicate(params)

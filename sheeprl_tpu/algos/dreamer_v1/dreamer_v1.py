@@ -1,0 +1,228 @@
+"""DreamerV1 — continuous-latent world-model RL
+(reference: sheeprl/algos/dreamer_v1/dreamer_v1.py:1-750, loss.py:41-95).
+
+World model: Gaussian RSSM trained with Gaussian reconstruction/reward NLL
+plus plain KL to the prior with free nats 3.0.  Behavior: value network
+trained on TD(λ) targets, actor maximizing λ-returns purely by dynamics
+backprop (no REINFORCE term, no target networks, no return normalization).
+
+Uses the shared Dreamer family loop and module stack (see dreamer_v1/agent).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from sheeprl_tpu.algos.dreamer_v1.agent import GaussianWorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values
+from sheeprl_tpu.utils.distribution import Bernoulli, Normal, kl_normal
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+                     cnn_keys, mlp_keys, is_continuous, p2e=None):
+    # ``p2e``: optional Plan2Explore hook {ens_module, ens_opt, w_intrinsic,
+    # w_extrinsic, n, multiplier} — mixes ensemble-disagreement intrinsic
+    # reward into the imagined returns and trains the ensembles
+    # (reference: sheeprl/algos/p2e_dv1 / p2e_dv2 exploration scripts).
+    obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
+    stoch = world_model.stoch_flat
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    use_continues = bool(cfg.algo.world_model.use_continues)
+    continue_scale = float(cfg.algo.world_model.continue_scale_factor)
+    WM = GaussianWorldModel
+
+    def wm_forward(wm_params, data, k):
+        L, B = data["rewards"].shape
+        obs = {kk: data[kk] for kk in obs_keys}
+        flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
+        embed = world_model.apply(wm_params, flat_obs, method=WM.encode).reshape(L, B, -1)
+        actions = jnp.concatenate([jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], 0)
+        is_first = data["is_first"].at[0].set(1.0)[..., None]
+
+        def step(carry, xs):
+            h, z = carry
+            embed_t, act_t, first_t, k_t = xs
+            h, z, post, prior = world_model.apply(
+                wm_params, h, z, act_t, embed_t, first_t, k_t, method=WM.dynamic
+            )
+            return (h, z), (h, z, post, prior)
+
+        keys = jax.random.split(k, L)
+        _, (hs, zs, post_m, prior_m) = jax.lax.scan(
+            step, (jnp.zeros((B, rec_size)), jnp.zeros((B, stoch))),
+            (embed, actions, is_first, keys),
+        )
+        latents = jnp.concatenate([zs, hs], -1)
+        flat_latents = latents.reshape(L * B, -1)
+
+        recon = world_model.apply(wm_params, flat_latents, method=WM.decode)
+        obs_loss = 0.0
+        for kk in cnn_keys:
+            obs_loss = obs_loss - Normal(recon[kk].reshape(obs[kk].shape), 1.0, event_dims=3).log_prob(obs[kk])
+        for kk in mlp_keys:
+            obs_loss = obs_loss - Normal(recon[kk].reshape(L, B, -1), 1.0, event_dims=1).log_prob(obs[kk])
+
+        reward_mean = world_model.apply(wm_params, flat_latents, method=WM.reward_logits)
+        reward_loss = -Normal(reward_mean.reshape(L, B), 1.0).log_prob(data["rewards"])
+
+        if use_continues:
+            cont_logits = world_model.apply(wm_params, flat_latents, method=WM.continue_logits)
+            continue_loss = -continue_scale * Bernoulli(cont_logits.reshape(L, B)).log_prob(
+                (1.0 - data["terminated"]) * gamma
+            )
+        else:
+            continue_loss = jnp.zeros_like(reward_loss)
+
+        post_mean, post_std = jnp.split(post_m, 2, -1)
+        prior_mean, prior_std = jnp.split(prior_m, 2, -1)
+        kl = kl_normal(
+            Normal(post_mean, post_std, event_dims=1), Normal(prior_mean, prior_std, event_dims=1)
+        )
+        state_loss = jnp.maximum(kl.mean(), kl_free_nats)
+
+        total = kl_regularizer * state_loss + (obs_loss + reward_loss + continue_loss).mean()
+        aux = {
+            "latents": latents,
+            "kl": kl.mean(),
+            "kl_loss": state_loss,
+            "observation_loss": obs_loss.mean(),
+            "reward_loss": reward_loss.mean(),
+            "continue_loss": continue_loss.mean(),
+        }
+        return total, aux
+
+    def behavior_update(p, o_state, latents, terminated, k):
+        L, B = terminated.shape
+        n = L * B
+        start_latents = jax.lax.stop_gradient(latents.reshape(n, -1))
+
+        def actor_loss_fn(actor_params):
+            def img_step(carry, k_t):
+                h, z = carry
+                latent = jnp.concatenate([z, h], -1)
+                k_a, k_z = jax.random.split(k_t)
+                head = actor.apply(actor_params, latent)  # grads flow via dynamics
+                action = actor.sample(head, k_a)
+                h, z = world_model.apply(p["world_model"], h, z, action, k_z, method=WM.imagination)
+                return (h, z), (latent, action)
+
+            keys = jax.random.split(k, horizon + 1)
+            _, (traj, actions_seq) = jax.lax.scan(
+                img_step, (start_latents[:, stoch:], start_latents[:, :stoch]), keys
+            )
+            flat_traj = traj.reshape((horizon + 1) * n, -1)
+            rewards = world_model.apply(p["world_model"], flat_traj, method=WM.reward_logits).reshape(
+                horizon + 1, n
+            )
+            if p2e is not None:
+                preds = p2e["ens_module"].apply(
+                    p["ensembles"],
+                    jax.lax.stop_gradient(
+                        jnp.concatenate([traj, actions_seq], -1)
+                    ).reshape((horizon + 1) * n, -1),
+                )
+                intrinsic = preds.reshape(p2e["n"], horizon + 1, n, -1).var(0).mean(-1)
+                rewards = p2e["w_extrinsic"] * rewards + p2e["w_intrinsic"] * intrinsic * p2e["multiplier"]
+            values = critic.apply(p["critic"], flat_traj).reshape(horizon + 1, n)
+            if use_continues:
+                continues = (
+                    Bernoulli(
+                        world_model.apply(p["world_model"], flat_traj, method=WM.continue_logits)
+                        .reshape(horizon + 1, n)
+                    ).mean
+                    / gamma
+                )
+                true_continue = (1.0 - terminated).reshape(1, n)
+                continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            else:
+                continues = jnp.ones((horizon + 1, n))
+
+            lambda_values = compute_lambda_values(
+                rewards[1:], values[1:], continues[1:] * gamma, lmbda
+            )
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, 0) / gamma)
+            # pure dynamics backprop: maximize λ-returns (Eq. 7 of Dreamer)
+            policy_loss = -jnp.mean(discount[:-1] * lambda_values)
+            return policy_loss, (traj, lambda_values, discount)
+
+        (pl, (traj, lambda_values, discount)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(p["actor"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+        traj_sg = jax.lax.stop_gradient(traj[:-1])
+        flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
+
+        def critic_loss_fn(critic_params):
+            qv = Normal(critic.apply(critic_params, flat_sg).reshape(horizon, -1), 1.0)
+            return -jnp.mean(qv.log_prob(jax.lax.stop_gradient(lambda_values)) * discount[:-1])
+
+        vl, c_grads = jax.value_and_grad(critic_loss_fn)(p["critic"])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+        return p, {**o_state, "actor": new_a_opt, "critic": new_c_opt}, pl, vl
+
+    def single_update(carry, inputs):
+        p, o_state, counter = carry
+        data, k = inputs
+        k_wm, k_beh = jax.random.split(k)
+        (wm_l, aux), wm_grads = jax.value_and_grad(wm_forward, has_aux=True)(
+            p["world_model"], data, k_wm
+        )
+        wm_updates, new_wm_opt = wm_opt.update(wm_grads, o_state["world_model"], p["world_model"])
+        p = {**p, "world_model": optax.apply_updates(p["world_model"], wm_updates)}
+        o_state = {**o_state, "world_model": new_wm_opt}
+        if p2e is not None:
+            L, B = data["rewards"].shape
+            latents = aux["latents"]
+
+            def ens_loss(ep):
+                inp = jax.lax.stop_gradient(
+                    jnp.concatenate([latents, data["actions"]], -1)
+                )[:-1].reshape((L - 1) * B, -1)
+                preds = p2e["ens_module"].apply(ep, inp)
+                target = jax.lax.stop_gradient(latents[1:, :, : world_model.stoch_flat])
+                return jnp.mean(
+                    (preds.reshape(p2e["n"], L - 1, B, -1) - target[None]) ** 2
+                )
+
+            el, e_grads = jax.value_and_grad(ens_loss)(p["ensembles"])
+            e_updates, new_e_opt = p2e["ens_opt"].update(e_grads, o_state["ensembles"], p["ensembles"])
+            p = {**p, "ensembles": optax.apply_updates(p["ensembles"], e_updates)}
+            o_state = {**o_state, "ensembles": new_e_opt}
+        p, o_state, pl, vl = behavior_update(p, o_state, aux["latents"], data["terminated"], k_beh)
+        zero = jnp.zeros(())
+        metrics = (
+            wm_l, aux["observation_loss"], aux["reward_loss"], aux["kl_loss"],
+            aux["continue_loss"], aux["kl"], pl, vl, zero, zero,
+        )
+        return (p, o_state, counter + 1), metrics
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, blocks, k, counter0):
+        U = blocks["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), metrics = jax.lax.scan(single_update, (p, o_state, counter0), (blocks, keys))
+        return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
+
+    return train_phase
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import dreamer_family_loop
+
+    dreamer_family_loop(fabric, cfg, build_agent, make_train_phase)

@@ -1,0 +1,14 @@
+"""DreamerV1 evaluation entrypoint (reference: sheeprl/algos/dreamer_v1/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent
+from sheeprl_tpu.algos.dreamer_v3.evaluate import _evaluate_dreamer
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="dreamer_v1")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    _evaluate_dreamer(fabric, cfg, state, build_agent)

@@ -1,0 +1,15 @@
+"""DreamerV1 utilities (reference: sheeprl/algos/dreamer_v1/utils.py)."""
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic"}

@@ -1,0 +1,345 @@
+"""DreamerV2 — discrete-latent world-model RL
+(reference: sheeprl/algos/dreamer_v2/dreamer_v2.py:1-792, agent.py:1-1104,
+loss.py:1-85).
+
+Shares the RSSM/encoder/decoder/actor module family with the DreamerV3
+implementation (the reference shares them the same way), configured for V2:
+ELU activations without LayerNorm stages, no unimix, no symlog inputs,
+Gaussian (unit-variance) observation/reward heads, α-balanced KL
+(kl_balancing_alpha=0.8, free-avg), a HARD-copied target value network, and
+a mixed REINFORCE/dynamics-backprop actor objective (``objective_mix``).
+
+TPU structure identical to DreamerV3: scanned RSSM, scanned imagination,
+one jitted dispatch per ratio window, host latent player.  Replay uses the
+sequential per-env buffer; ``buffer.type=episode`` selects the EpisodeBuffer
+with end-prioritized sampling (reference supports both for V2).
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel, build_agent as dv3_build_agent
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, prepare_obs
+from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical, kl_categorical
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
+    """DV3 module family with V2 settings (see module docstring)."""
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    wm_cfg = cfg.algo.world_model
+    cnn_shapes = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        if len(shape) == 4:
+            shape = (shape[1], shape[2], shape[0] * shape[3])
+        cnn_shapes[k] = tuple(shape)
+    mlp_shapes = {k: int(np.prod(obs_space[k].shape)) for k in mlp_keys}
+    dtype = fabric.precision.compute_dtype
+
+    world_model = WorldModel(
+        cnn_keys=cnn_keys, mlp_keys=mlp_keys, cnn_shapes=cnn_shapes, mlp_shapes=mlp_shapes,
+        actions_dim=tuple(actions_dim),
+        cnn_mult=wm_cfg.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.dense_units,
+        mlp_layers=cfg.algo.mlp_layers,
+        recurrent_size=wm_cfg.recurrent_model.recurrent_state_size,
+        hidden_size=wm_cfg.transition_model.hidden_size,
+        repr_hidden_size=wm_cfg.representation_model.hidden_size,
+        stochastic_size=wm_cfg.stochastic_size,
+        discrete_size=wm_cfg.discrete_size,
+        unimix=0.0,
+        bins=1,                      # Gaussian reward head
+        act=cfg.algo.dense_act,
+        layer_norm=bool(cfg.algo.layer_norm),
+        symlog_inputs=False,
+        learnable_initial_state=False,
+        dtype=dtype,
+    )
+    actor = Actor(
+        actions_dim=tuple(actions_dim), is_continuous=is_continuous,
+        dense_units=cfg.algo.actor.dense_units, mlp_layers=cfg.algo.actor.mlp_layers,
+        act=cfg.algo.dense_act, layer_norm=bool(cfg.algo.layer_norm), unimix=0.0,
+        min_std=cfg.algo.actor.min_std, max_std=1.0,
+        init_std=cfg.algo.actor.init_std, action_clip=1.0, dtype=dtype,
+    )
+    critic = Critic(
+        dense_units=cfg.algo.critic.dense_units, mlp_layers=cfg.algo.critic.mlp_layers,
+        act=cfg.algo.dense_act, layer_norm=bool(cfg.algo.layer_norm), bins=1, dtype=dtype,
+    )
+    if state is not None:
+        return world_model, actor, critic, fabric.replicate(state)
+
+    key = jax.random.PRNGKey(cfg.seed)
+    k_wm, k_actor, k_critic, k_s = jax.random.split(key, 4)
+    dummy_obs = {}
+    for k in cnn_keys:
+        dummy_obs[k] = jnp.zeros((1, *cnn_shapes[k]), jnp.float32)
+    for k in mlp_keys:
+        dummy_obs[k] = jnp.zeros((1, mlp_shapes[k]), jnp.float32)
+    stoch = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    rec = wm_cfg.recurrent_model.recurrent_state_size
+    wm_params = world_model.init(
+        k_wm, dummy_obs, jnp.zeros((1, rec)), jnp.zeros((1, stoch)),
+        jnp.zeros((1, int(sum(actions_dim)))), jnp.ones((1, 1)), k_s,
+    )
+    latent = jnp.zeros((1, stoch + rec))
+    params = {
+        "world_model": wm_params,
+        "actor": actor.init(k_actor, latent),
+        "critic": (cp := critic.init(k_critic, latent)),
+        "target_critic": jax.tree.map(jnp.copy, cp),
+    }
+    return world_model, actor, critic, fabric.replicate(params)
+
+
+def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+                     cnn_keys, mlp_keys, is_continuous, p2e=None):
+    # ``p2e``: optional Plan2Explore hook {ens_module, ens_opt, w_intrinsic,
+    # w_extrinsic, n, multiplier} — mixes ensemble-disagreement intrinsic
+    # reward into the imagined returns and trains the ensembles
+    # (reference: sheeprl/algos/p2e_dv1 / p2e_dv2 exploration scripts).
+    obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
+    stoch_flat = world_model.stoch_flat
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    target_freq = int(cfg.algo.critic.target_network_update_freq)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    objective_mix = float(cfg.algo.actor.objective_mix)
+    kl_alpha = float(cfg.algo.world_model.kl_balancing_alpha)
+    kl_free_nats = float(cfg.algo.world_model.kl_free_nats)
+    kl_regularizer = float(cfg.algo.world_model.kl_regularizer)
+    use_continues = bool(cfg.algo.world_model.use_continues)
+    discount_scale = float(cfg.algo.world_model.discount_scale_factor)
+
+    def wm_forward(wm_params, data, k):
+        L, B = data["rewards"].shape
+        obs = {kk: data[kk] for kk in obs_keys}
+        flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
+        embed = world_model.apply(wm_params, flat_obs, method=WorldModel.encode).reshape(L, B, -1)
+        actions = jnp.concatenate([jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], 0)
+        is_first = data["is_first"].at[0].set(1.0)[..., None]
+        h0 = jnp.zeros((B, rec_size))
+        z0 = jnp.zeros((B, stoch_flat))
+
+        def step(carry, xs):
+            h, z = carry
+            embed_t, act_t, first_t, k_t = xs
+            h, z, post_logits, prior_logits = world_model.apply(
+                wm_params, h, z, act_t, embed_t, first_t, k_t, method=WorldModel.dynamic
+            )
+            return (h, z), (h, z, post_logits, prior_logits)
+
+        keys = jax.random.split(k, L)
+        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+            step, (h0, z0), (embed, actions, is_first, keys)
+        )
+        latents = jnp.concatenate([zs, hs], -1)
+        flat_latents = latents.reshape(L * B, -1)
+
+        recon = world_model.apply(wm_params, flat_latents, method=WorldModel.decode)
+        obs_loss = 0.0
+        for kk in cnn_keys:
+            dist = Normal(recon[kk].reshape(obs[kk].shape), 1.0, event_dims=3)
+            obs_loss = obs_loss - dist.log_prob(obs[kk])
+        for kk in mlp_keys:
+            dist = Normal(recon[kk].reshape(L, B, -1), 1.0, event_dims=1)
+            obs_loss = obs_loss - dist.log_prob(obs[kk])
+
+        reward_mean = world_model.apply(wm_params, flat_latents, method=WorldModel.reward_logits)
+        pr = Normal(reward_mean.reshape(L, B), 1.0)
+        reward_loss = -pr.log_prob(data["rewards"])
+
+        if use_continues:
+            cont_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.continue_logits)
+            pc = Bernoulli(cont_logits.reshape(L, B))
+            continue_loss = -discount_scale * pc.log_prob((1.0 - data["terminated"]) * gamma)
+        else:
+            continue_loss = jnp.zeros_like(reward_loss)
+
+        # α-balanced KL with free-avg (reference: dreamer_v2/loss.py:60-79)
+        post = OneHotCategorical(post_logits)
+        post_sg = OneHotCategorical(jax.lax.stop_gradient(post_logits))
+        prior = OneHotCategorical(prior_logits)
+        prior_sg = OneHotCategorical(jax.lax.stop_gradient(prior_logits))
+        lhs = kl_categorical(post_sg, prior).sum(-1)
+        rhs = kl_categorical(post, prior_sg).sum(-1)
+        kl = lhs
+        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+        kl_loss = kl_alpha * loss_lhs + (1 - kl_alpha) * loss_rhs
+
+        total = kl_regularizer * kl_loss + (obs_loss + reward_loss + continue_loss).mean()
+        aux = {
+            "latents": latents,
+            "post_logits": post_logits,
+            "prior_logits": prior_logits,
+            "kl": kl.mean(),
+            "kl_loss": kl_loss,
+            "observation_loss": obs_loss.mean(),
+            "reward_loss": reward_loss.mean(),
+            "continue_loss": continue_loss.mean(),
+        }
+        return total, aux
+
+    def behavior_update(p, o_state, latents, terminated, k):
+        L, B = terminated.shape
+        n = L * B
+        start_latents = jax.lax.stop_gradient(latents.reshape(n, -1))
+
+        def actor_loss_fn(actor_params):
+            def img_step(carry, k_t):
+                h, z = carry
+                latent = jnp.concatenate([z, h], -1)
+                k_a, k_z = jax.random.split(k_t)
+                head = actor.apply(actor_params, jax.lax.stop_gradient(latent))
+                action = actor.sample(head, k_a)
+                h, z = world_model.apply(
+                    p["world_model"], h, z, action, k_z, method=WorldModel.imagination
+                )
+                return (h, z), (latent, action)
+
+            h0 = start_latents[:, stoch_flat:]
+            z0 = start_latents[:, :stoch_flat]
+            keys = jax.random.split(k, horizon + 1)
+            _, (traj, actions_seq) = jax.lax.scan(img_step, (h0, z0), keys)
+            flat_traj = traj.reshape((horizon + 1) * n, -1)
+            rewards = world_model.apply(
+                p["world_model"], flat_traj, method=WorldModel.reward_logits
+            ).reshape(horizon + 1, n)
+            if p2e is not None:
+                preds = p2e["ens_module"].apply(
+                    p["ensembles"],
+                    jax.lax.stop_gradient(
+                        jnp.concatenate([traj, actions_seq], -1)
+                    ).reshape((horizon + 1) * n, -1),
+                )
+                intrinsic = preds.reshape(p2e["n"], horizon + 1, n, -1).var(0).mean(-1)
+                rewards = p2e["w_extrinsic"] * rewards + p2e["w_intrinsic"] * intrinsic * p2e["multiplier"]
+            values_t = critic.apply(p["target_critic"], flat_traj).reshape(horizon + 1, n)
+            if use_continues:
+                continues = Bernoulli(
+                    world_model.apply(p["world_model"], flat_traj, method=WorldModel.continue_logits)
+                    .reshape(horizon + 1, n)
+                ).mean / gamma  # head predicts γ·(1-done); back to (1-done)
+            else:
+                continues = jnp.ones((horizon + 1, n))
+            true_continue = (1.0 - terminated).reshape(1, n)
+            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+
+            lambda_values = compute_lambda_values(
+                rewards[1:], values_t[1:], continues[1:] * gamma, lmbda
+            )
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, 0) / gamma)
+
+            baseline = values_t[:-1]
+            advantage = jax.lax.stop_gradient(lambda_values - baseline)
+            heads = actor.apply(actor_params, jax.lax.stop_gradient(traj))
+            lp = actor.log_prob(heads[:-1], jax.lax.stop_gradient(actions_seq[:-1]))
+            reinforce = lp * advantage
+            dynamics = lambda_values
+            objective = objective_mix * reinforce + (1 - objective_mix) * dynamics
+            entropy = actor.entropy(heads[:-1])
+            policy_loss = -jnp.mean(discount[:-1] * (objective + ent_coef * entropy))
+            return policy_loss, (traj, lambda_values, discount)
+
+        (pl, (traj, lambda_values, discount)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(p["actor"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+        traj_sg = jax.lax.stop_gradient(traj[:-1])
+        flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
+
+        def critic_loss_fn(critic_params):
+            qv = Normal(critic.apply(critic_params, flat_sg).reshape(horizon, -1), 1.0)
+            return -jnp.mean(qv.log_prob(jax.lax.stop_gradient(lambda_values)) * discount[:-1])
+
+        vl, c_grads = jax.value_and_grad(critic_loss_fn)(p["critic"])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+        return p, {**o_state, "actor": new_a_opt, "critic": new_c_opt}, pl, vl
+
+    def single_update(carry, inputs):
+        p, o_state, counter = carry
+        data, k = inputs
+        k_wm, k_beh = jax.random.split(k)
+        (wm_l, aux), wm_grads = jax.value_and_grad(wm_forward, has_aux=True)(
+            p["world_model"], data, k_wm
+        )
+        wm_updates, new_wm_opt = wm_opt.update(wm_grads, o_state["world_model"], p["world_model"])
+        p = {**p, "world_model": optax.apply_updates(p["world_model"], wm_updates)}
+        o_state = {**o_state, "world_model": new_wm_opt}
+        if p2e is not None:
+            L, B = data["rewards"].shape
+            latents = aux["latents"]
+
+            def ens_loss(ep):
+                inp = jax.lax.stop_gradient(
+                    jnp.concatenate([latents, data["actions"]], -1)
+                )[:-1].reshape((L - 1) * B, -1)
+                preds = p2e["ens_module"].apply(ep, inp)
+                target = jax.lax.stop_gradient(latents[1:, :, : world_model.stoch_flat])
+                return jnp.mean(
+                    (preds.reshape(p2e["n"], L - 1, B, -1) - target[None]) ** 2
+                )
+
+            el, e_grads = jax.value_and_grad(ens_loss)(p["ensembles"])
+            e_updates, new_e_opt = p2e["ens_opt"].update(e_grads, o_state["ensembles"], p["ensembles"])
+            p = {**p, "ensembles": optax.apply_updates(p["ensembles"], e_updates)}
+            o_state = {**o_state, "ensembles": new_e_opt}
+
+        p, o_state, pl, vl = behavior_update(p, o_state, aux["latents"], data["terminated"], k_beh)
+
+        # HARD target copy every target_freq updates (reference: dv2 value
+        # target update)
+        do_copy = (counter % target_freq) == 0
+        p = {
+            **p,
+            "target_critic": jax.tree.map(
+                lambda c, t: jnp.where(do_copy, c, t), p["critic"], p["target_critic"]
+            ),
+        }
+        post_ent = OneHotCategorical(jax.lax.stop_gradient(aux["post_logits"])).entropy().sum(-1).mean()
+        prior_ent = OneHotCategorical(jax.lax.stop_gradient(aux["prior_logits"])).entropy().sum(-1).mean()
+        metrics = (
+            wm_l, aux["observation_loss"], aux["reward_loss"], aux["kl_loss"],
+            aux["continue_loss"], aux["kl"], pl, vl, post_ent, prior_ent,
+        )
+        return (p, o_state, counter + 1), metrics
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, blocks, k, counter0):
+        U = blocks["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), metrics = jax.lax.scan(single_update, (p, o_state, counter0), (blocks, keys))
+        return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
+
+    return train_phase
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import dreamer_family_loop
+
+    dreamer_family_loop(fabric, cfg, build_agent, make_train_phase)

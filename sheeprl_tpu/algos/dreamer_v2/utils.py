@@ -1,0 +1,17 @@
+"""DreamerV2 utilities (reference: sheeprl/algos/dreamer_v2/utils.py)."""
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/world_model_loss",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/observation_loss",
+    "Loss/reward_loss",
+    "Loss/state_loss",
+    "Loss/continue_loss",
+    "State/kl",
+    "State/post_entropy",
+    "State/prior_entropy",
+}
+MODELS_TO_REGISTER = {"world_model", "actor", "critic", "target_critic"}

@@ -83,6 +83,8 @@ class Encoder(nn.Module):
     mlp_units: int = 512
     mlp_layers: int = 2
     act: str = "silu"
+    layer_norm: bool = True
+    symlog_inputs: bool = True   # V1/V2 feed raw vectors
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -94,20 +96,22 @@ class Encoder(nn.Module):
             stages = [self.cnn_mult * m for m in (1, 2, 4, 8)]
             for i, c in enumerate(stages):
                 x = nn.Conv(
-                    c, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
+                    c, (4, 4), strides=(2, 2), padding="SAME", use_bias=not self.layer_norm,
                     kernel_init=trunk_init, dtype=self.dtype, param_dtype=jnp.float32,
                     name=f"conv_{i}",
                 )(x)
-                x = LayerNorm(dtype=self.dtype, eps=1e-3, name=f"cnn_ln_{i}")(x)
+                if self.layer_norm:
+                    x = LayerNorm(dtype=self.dtype, eps=1e-3, name=f"cnn_ln_{i}")(x)
                 x = act(x)
             feats.append(x.reshape(*x.shape[:-3], -1))
         if self.mlp_keys:
             v = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
-            v = symlog(v)
+            if self.symlog_inputs:
+                v = symlog(v)
             feats.append(
                 DreamerMLP(
                     units=self.mlp_units, layers=self.mlp_layers, act=self.act,
-                    dtype=self.dtype, name="mlp_encoder",
+                    layer_norm=self.layer_norm, dtype=self.dtype, name="mlp_encoder",
                 )(v)
             )
         return jnp.concatenate(feats, axis=-1)
@@ -125,6 +129,7 @@ class Decoder(nn.Module):
     mlp_units: int = 512
     mlp_layers: int = 2
     act: str = "silu"
+    layer_norm: bool = True
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -137,11 +142,12 @@ class Decoder(nn.Module):
             x = x.reshape(*x.shape[:-1], 4, 4, self.cnn_mult * 8)
             for i, c in enumerate((self.cnn_mult * 4, self.cnn_mult * 2, self.cnn_mult)):
                 x = nn.ConvTranspose(
-                    c, (4, 4), strides=(2, 2), padding="SAME", use_bias=False,
+                    c, (4, 4), strides=(2, 2), padding="SAME", use_bias=not self.layer_norm,
                     kernel_init=trunk_init, dtype=self.dtype, param_dtype=jnp.float32,
                     name=f"deconv_{i}",
                 )(x)
-                x = LayerNorm(dtype=self.dtype, eps=1e-3, name=f"cnn_ln_{i}")(x)
+                if self.layer_norm:
+                    x = LayerNorm(dtype=self.dtype, eps=1e-3, name=f"cnn_ln_{i}")(x)
                 x = act(x)
             x = nn.ConvTranspose(
                 total_c, (4, 4), strides=(2, 2), padding="SAME",
@@ -201,6 +207,8 @@ class WorldModel(nn.Module):
     unimix: float = 0.01
     bins: int = 255
     act: str = "silu"
+    layer_norm: bool = True
+    symlog_inputs: bool = True
     learnable_initial_state: bool = True
     decoupled_rssm: bool = False
     dtype: Any = jnp.float32
@@ -213,6 +221,7 @@ class WorldModel(nn.Module):
         self.encoder = Encoder(
             cnn_keys=self.cnn_keys, mlp_keys=self.mlp_keys, cnn_mult=self.cnn_mult,
             mlp_units=self.dense_units, mlp_layers=self.mlp_layers, act=self.act,
+            layer_norm=self.layer_norm, symlog_inputs=self.symlog_inputs,
             dtype=self.dtype, name="encoder",
         )
         self.recurrent_model = RecurrentModel(
@@ -222,25 +231,29 @@ class WorldModel(nn.Module):
         # posterior: (h ⊕ embed) → logits; prior: h → logits
         self.representation_model = DreamerMLP(
             units=self.repr_hidden_size, layers=1, output_dim=self.stoch_flat,
-            act=self.act, dtype=self.dtype, name="representation_model",
+            act=self.act, layer_norm=self.layer_norm, dtype=self.dtype,
+            name="representation_model",
         )
         self.transition_model = DreamerMLP(
             units=self.hidden_size, layers=1, output_dim=self.stoch_flat,
-            act=self.act, dtype=self.dtype, name="transition_model",
+            act=self.act, layer_norm=self.layer_norm, dtype=self.dtype,
+            name="transition_model",
         )
         self.observation_model = Decoder(
             cnn_keys=self.cnn_keys, mlp_keys=self.mlp_keys, cnn_shapes=self.cnn_shapes,
             mlp_shapes=self.mlp_shapes, cnn_mult=self.cnn_mult, mlp_units=self.dense_units,
-            mlp_layers=self.mlp_layers, act=self.act, dtype=self.dtype,
-            name="observation_model",
+            mlp_layers=self.mlp_layers, act=self.act, layer_norm=self.layer_norm,
+            dtype=self.dtype, name="observation_model",
         )
         self.reward_model = DreamerMLP(
             units=self.dense_units, layers=self.mlp_layers, output_dim=self.bins,
-            act=self.act, zero_head=True, dtype=self.dtype, name="reward_model",
+            act=self.act, layer_norm=self.layer_norm, zero_head=True,
+            dtype=self.dtype, name="reward_model",
         )
         self.continue_model = DreamerMLP(
             units=self.dense_units, layers=self.mlp_layers, output_dim=1,
-            act=self.act, zero_head=True, dtype=self.dtype, name="continue_model",
+            act=self.act, layer_norm=self.layer_norm, zero_head=True,
+            dtype=self.dtype, name="continue_model",
         )
         if self.learnable_initial_state:
             self.initial_recurrent = self.param(
@@ -342,6 +355,7 @@ class Actor(nn.Module):
     dense_units: int = 512
     mlp_layers: int = 2
     act: str = "silu"
+    layer_norm: bool = True
     unimix: float = 0.01
     min_std: float = 0.1
     max_std: float = 1.0
@@ -353,7 +367,7 @@ class Actor(nn.Module):
     def __call__(self, latent: jax.Array) -> jax.Array:
         trunk = DreamerMLP(
             units=self.dense_units, layers=self.mlp_layers, act=self.act,
-            dtype=self.dtype, name="trunk",
+            layer_norm=self.layer_norm, dtype=self.dtype, name="trunk",
         )(latent)
         out_dim = sum(self.actions_dim) * (2 if self.is_continuous else 1)
         return _dense(out_dim, jnp.float32, "head")(trunk)
@@ -406,6 +420,7 @@ class Critic(nn.Module):
     dense_units: int = 512
     mlp_layers: int = 2
     act: str = "silu"
+    layer_norm: bool = True
     bins: int = 255
     dtype: Any = jnp.float32
 
@@ -413,7 +428,7 @@ class Critic(nn.Module):
     def __call__(self, latent: jax.Array) -> jax.Array:
         x = DreamerMLP(
             units=self.dense_units, layers=self.mlp_layers, act=self.act,
-            dtype=self.dtype, name="trunk",
+            layer_norm=self.layer_norm, dtype=self.dtype, name="trunk",
         )(latent)
         return _dense(self.bins, jnp.float32, "head", zero=True)(x)
 

@@ -43,7 +43,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
 )
 from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
     MSEDistribution,
@@ -80,6 +80,21 @@ def build_dv3_optimizers(fabric, cfg, params, saved_opt_state=None):
 
 @register_algorithm()
 def main(fabric: Any, cfg: Any) -> None:
+    dreamer_family_loop(fabric, cfg, build_agent, make_train_phase)
+
+
+def dreamer_family_loop(
+    fabric: Any,
+    cfg: Any,
+    build_agent_fn: Any,
+    make_train_phase_fn: Any,
+    optimizer_builder: Any = None,
+    initial_state: Any = None,
+) -> None:
+    """Shared env/replay/dispatch loop of the Dreamer family (V1/V2/V3 and
+    the P2E variants differ in modules and losses, not in this loop —
+    mirroring how the reference keeps per-version mains structurally
+    identical)."""
     rank = fabric.global_rank
     key = fabric.seed_everything(cfg.seed)
 
@@ -107,13 +122,15 @@ def main(fabric: Any, cfg: Any) -> None:
     obs_keys = cnn_keys + mlp_keys
 
     # ---------------- agent / optimizers ------------------------------------
-    state: Dict[str, Any] = {}
+    state: Dict[str, Any] = dict(initial_state or {})
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
-    world_model, actor, critic, params = build_agent(
+    world_model, actor, critic, params = build_agent_fn(
         fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
     )
-    wm_opt, actor_opt, critic_opt, opt_state = build_dv3_optimizers(
+    WM = type(world_model)
+    builder = optimizer_builder or build_dv3_optimizers
+    wm_opt, actor_opt, critic_opt, opt_state = builder(
         fabric, cfg, params, state.get("opt_state")
     )
 
@@ -123,13 +140,6 @@ def main(fabric: Any, cfg: Any) -> None:
     host = fabric.host_device
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
-    horizon = int(cfg.algo.horizon)
-    gamma = float(cfg.algo.gamma)
-    lmbda = float(cfg.algo.lmbda)
-    tau = float(cfg.algo.critic.tau)
-    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    moments_cfg = cfg.algo.actor.moments
 
     # ---------------- host player --------------------------------------------
     @partial(jax.jit, static_argnames=("greedy",))
@@ -137,10 +147,10 @@ def main(fabric: Any, cfg: Any) -> None:
         """(h, z, prev_action) carry; returns new carry + env-space action."""
         h, z, prev_a = carry
         k_repr, k_act = jax.random.split(k)
-        embed = world_model.apply(p["world_model"], obs, method=WorldModel.encode)
+        embed = world_model.apply(p["world_model"], obs, method=WM.encode)
         is_first = jnp.zeros((h.shape[0], 1))
         h, z, _, _ = world_model.apply(
-            p["world_model"], h, z, prev_a, embed, is_first, k_repr, method=WorldModel.dynamic
+            p["world_model"], h, z, prev_a, embed, is_first, k_repr, method=WM.dynamic
         )
         latent = jnp.concatenate([z, h], -1)
         head = actor.apply(p["actor"], latent)
@@ -172,7 +182,7 @@ def main(fabric: Any, cfg: Any) -> None:
         return carry, a
 
     # ---------------- single-dispatch multi-update train phase ---------------
-    train_phase = make_train_phase(
+    train_phase = make_train_phase_fn(
         fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
         cnn_keys=cnn_keys, mlp_keys=mlp_keys, is_continuous=is_continuous,
     )
@@ -180,23 +190,39 @@ def main(fabric: Any, cfg: Any) -> None:
     # ---------------- replay buffer ------------------------------------------
     seq_len = int(cfg.algo.per_rank_sequence_length)
     batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
-    rb = EnvIndependentReplayBuffer(
-        max(int(cfg.buffer.size) // num_envs, seq_len * 2),
-        n_envs=num_envs,
-        buffer_cls=SequentialReplayBuffer,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
-    if state and cfg.buffer.checkpoint and "rb" in state:
+    if cfg.buffer.get("type", "sequential") == "episode":
+        rb = EpisodeBuffer(
+            max(int(cfg.buffer.size), seq_len * 4),
+            sequence_length=seq_len,
+            n_envs=num_envs,
+            prioritize_ends=bool(cfg.buffer.get("prioritize_ends", False)),
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}")
+            if cfg.buffer.memmap
+            else None,
+        )
+    else:
+        rb = EnvIndependentReplayBuffer(
+            max(int(cfg.buffer.size) // num_envs, seq_len * 2),
+            n_envs=num_envs,
+            buffer_cls=SequentialReplayBuffer,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        )
+    # a checkpoint only contains "rb" if it was saved with buffer.checkpoint
+    # (or injected explicitly, e.g. P2E finetuning's load_from_exploration) —
+    # so presence alone decides
+    if state and state.get("rb") is not None:
         rb.load_state_dict({"buffers": state["rb"]}) if isinstance(state["rb"], list) else rb.load_state_dict(state["rb"])
 
     # ---------------- counters ------------------------------------------------
     policy_steps_per_iter = num_envs * int(cfg.env.action_repeat)
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
-        # dry run = collect just enough for one sequence sample, then ONE
-        # optimization dispatch
-        total_iters = int(cfg.algo.per_rank_sequence_length) + 2
+        # dry run = collect just enough for one sequence sample (2x for the
+        # EpisodeBuffer, which must first COMMIT a >=seq_len episode), then
+        # ONE optimization dispatch
+        total_iters = 2 * int(cfg.algo.per_rank_sequence_length) + 4
     learning_starts = int(cfg.algo.learning_starts) // policy_steps_per_iter if not cfg.dry_run else 0
     start_iter = int(state.get("update", 0)) + 1 if state else 1
     policy_step = int(state.get("policy_step", 0))
@@ -312,7 +338,10 @@ def main(fabric: Any, cfg: Any) -> None:
                     c_old[done_idx] = c_new
 
         # ---------------- training -------------------------------------------
-        can_sample = any(len(b) > seq_len for b in rb.buffer)
+        if isinstance(rb, EpisodeBuffer):
+            can_sample = len(rb) > seq_len and len(rb.buffer) > 0
+        else:
+            can_sample = any(len(b) > seq_len for b in rb.buffer)
         if update >= learning_starts and can_sample:
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if cfg.dry_run:

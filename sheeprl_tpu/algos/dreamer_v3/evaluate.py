@@ -19,15 +19,20 @@ from sheeprl_tpu.utils.registry import register_evaluation
 
 @register_evaluation(algorithms="dreamer_v3")
 def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    _evaluate_dreamer(fabric, cfg, state, build_agent)
+
+
+def _evaluate_dreamer(fabric: Any, cfg: Any, state: Dict[str, Any], build_agent_fn: Any) -> None:
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
     env = make_env(cfg, cfg.seed, 0)()
     actions_dim, is_continuous = spaces_to_dims(env.action_space)
     obs_space = env.observation_space
     env.close()
-    world_model, actor, critic, params = build_agent(
+    world_model, actor, critic, params = build_agent_fn(
         fabric, actions_dim, is_continuous, cfg, obs_space, state["agent"]
     )
+    WM = type(world_model)
     act_width = int(sum(actions_dim))
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
     stoch_flat = world_model.stoch_flat
@@ -37,10 +42,10 @@ def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
     def _step(p, carry, obs, k, greedy=True):
         h, z, prev_a = carry
         k_repr, k_act = jax.random.split(k)
-        embed = world_model.apply(p["world_model"], obs, method=WorldModel.encode)
+        embed = world_model.apply(p["world_model"], obs, method=WM.encode)
         h, z, _, _ = world_model.apply(
             p["world_model"], h, z, prev_a, embed, jnp.zeros((h.shape[0], 1)), k_repr,
-            method=WorldModel.dynamic,
+            method=WM.dynamic,
         )
         latent = jnp.concatenate([z, h], -1)
         action = actor.sample(actor.apply(p["actor"], latent), k_act, greedy=greedy)

@@ -1,0 +1,93 @@
+"""DroQ agent (flax).
+
+Capability parity with the reference (reference: sheeprl/algos/droq/agent.py:20-278):
+SAC with a dropout + LayerNorm Q-ensemble (https://arxiv.org/abs/2110.02034)
+enabling very high replay ratios.  Actor and temperature machinery are
+shared with SAC.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor
+from sheeprl_tpu.models.models import LayerNorm
+
+
+class DroQCriticEnsemble(nn.Module):
+    """N Q-functions with per-layer Dropout + LayerNorm, params-vmapped."""
+
+    n_critics: int = 2
+    hidden_size: int = 256
+    dropout: float = 0.01
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: jax.Array, action: jax.Array, *, train: bool = False) -> jax.Array:
+        x = jnp.concatenate([obs, action], axis=-1)
+
+        class _OneQ(nn.Module):
+            hidden: int
+            dropout: float
+            dtype: Any
+
+            @nn.compact
+            def __call__(self, x, train: bool):
+                for i in range(2):
+                    x = nn.Dense(self.hidden, dtype=self.dtype, name=f"dense_{i}")(x)
+                    if self.dropout > 0:
+                        x = nn.Dropout(self.dropout, deterministic=not train)(x)
+                    x = LayerNorm(dtype=self.dtype, name=f"ln_{i}")(x)
+                    x = nn.relu(x)
+                return nn.Dense(1, dtype=jnp.float32, name="head")(x)
+
+        q_net = nn.vmap(
+            _OneQ,
+            in_axes=(None, None),
+            out_axes=0,
+            axis_size=self.n_critics,
+            variable_axes={"params": 0},
+            split_rngs={"params": True, "dropout": True},
+        )
+        q = q_net(self.hidden_size, self.dropout, self.dtype, name="q_ensemble")(x, train)
+        return q[..., 0]  # (N, B)
+
+
+def build_agent(
+    fabric: Any,
+    act_dim: int,
+    cfg: Any,
+    obs_dim: int,
+    state: Optional[Dict[str, Any]] = None,
+) -> Tuple[SACActor, DroQCriticEnsemble, Dict[str, Any]]:
+    actor = SACActor(
+        act_dim=act_dim,
+        hidden_size=cfg.algo.actor.hidden_size,
+        dtype=fabric.precision.compute_dtype,
+    )
+    critic = DroQCriticEnsemble(
+        n_critics=cfg.algo.critic.n,
+        hidden_size=cfg.algo.critic.hidden_size,
+        dropout=float(cfg.algo.critic.dropout),
+        dtype=fabric.precision.compute_dtype,
+    )
+    if state is not None:
+        params = state
+    else:
+        k1, k2 = jax.random.split(jax.random.PRNGKey(cfg.seed))
+        dummy_obs = jnp.zeros((1, obs_dim), jnp.float32)
+        dummy_act = jnp.zeros((1, act_dim), jnp.float32)
+        actor_params = actor.init(k1, dummy_obs)
+        critic_params = critic.init(k2, dummy_obs, dummy_act)
+        params = {
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "log_alpha": jnp.asarray(np.log(cfg.algo.alpha.alpha), jnp.float32),
+        }
+    return actor, critic, fabric.replicate(params)

@@ -1,0 +1,23 @@
+"""DroQ — SAC with dropout/LayerNorm Q-ensembles and high replay ratio
+(reference: sheeprl/algos/droq/droq.py:140-436).
+
+Reuses the SAC engine with a dropout-active critic apply: DroQ's entire
+algorithmic delta vs SAC is the critic regularization + replay_ratio=20
+(reference derives it the same way).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import sac_loop
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    def dropout_apply(critic, cp, o, a, k):
+        return critic.apply(cp, o, a, train=True, rngs={"dropout": k})
+
+    sac_loop(fabric, cfg, build_agent, dropout_apply)

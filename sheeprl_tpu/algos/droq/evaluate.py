@@ -1,0 +1,26 @@
+"""DroQ evaluation entrypoint (reference: sheeprl/algos/droq/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from sheeprl_tpu.algos.droq.agent import build_agent
+from sheeprl_tpu.algos.sac.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="droq")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    env = make_env(cfg, cfg.seed, 0)()
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(env.observation_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(env.action_space.shape))
+    env.close()
+    actor, critic, params = build_agent(fabric, act_dim, cfg, obs_dim, state["agent"])
+    test(actor, fabric.to_host(params["actor"]), cfg, log_dir, logger)

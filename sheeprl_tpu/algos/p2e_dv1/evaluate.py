@@ -1,0 +1,16 @@
+"""P2E DV1 evaluation (reference: sheeprl/algos/p2e_dv1/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as base_build_agent
+from sheeprl_tpu.algos.dreamer_v3.evaluate import _evaluate_dreamer
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms=["p2e_dv1_exploration", "p2e_dv1_finetuning"], name="p2e_dv1")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    agent = dict(state["agent"])
+    agent.pop("ensembles", None)
+    _evaluate_dreamer(fabric, cfg, {"agent": agent}, base_build_agent)

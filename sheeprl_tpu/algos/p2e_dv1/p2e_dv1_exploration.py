@@ -1,0 +1,108 @@
+"""Plan2Explore over DreamerV1 — exploration phase
+(reference: sheeprl/algos/p2e_dv1/p2e_dv1_exploration.py).
+
+An ensemble of N forward models is trained to predict the next stochastic
+state from the current latent; its prediction variance is the intrinsic
+reward, mixed into the imagined returns with configured weights while the
+ensembles train alongside the world model.  Simplification vs the reference
+(documented): a single actor/critic learns the MIXED intrinsic+extrinsic
+return instead of the per-reward critic dict (the full dict lives in the
+DV3 variant, sheeprl_tpu/algos/p2e_dv3).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.algos.dreamer_v1.agent import build_agent as base_build_agent
+from sheeprl_tpu.algos.dreamer_v1.dreamer_v1 import make_train_phase as base_make_train_phase
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
+    world_model, actor, critic, params = base_build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, state
+    )
+    if state is not None:
+        return world_model, actor, critic, params
+    from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration import ensemble_module
+
+    ens = _ensemble(cfg, world_model)
+    rec = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    latent_dim = world_model.stoch_flat + rec + int(sum(actions_dim))
+    ens_params = ens.init(jax.random.PRNGKey(cfg.seed + 1), jnp.zeros((1, latent_dim)))
+    params = jax.device_get(params)
+    params = {**params, "ensembles": ens_params}
+    return world_model, actor, critic, fabric.replicate(params)
+
+
+def _ensemble(cfg, world_model):
+    import flax.linen as nn
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import DreamerMLP
+
+    class Ensembles(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            net = nn.vmap(
+                DreamerMLP, in_axes=None, out_axes=0,
+                axis_size=int(cfg.algo.ensembles.n),
+                variable_axes={"params": 0}, split_rngs={"params": True},
+            )
+            return net(
+                units=cfg.algo.ensembles.dense_units,
+                layers=cfg.algo.ensembles.mlp_layers,
+                output_dim=world_model.stoch_flat,
+                act=cfg.algo.dense_act,
+                layer_norm=False,
+                name="ens",
+            )(x)
+
+    return Ensembles()
+
+
+def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+                     cnn_keys, mlp_keys, is_continuous):
+    p2e = {
+        "ens_module": _ensemble(cfg, world_model),
+        "ens_opt": build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
+        "n": int(cfg.algo.ensembles.n),
+        "w_intrinsic": float(cfg.algo.critics_exploration.intrinsic.weight),
+        "w_extrinsic": float(cfg.algo.critics_exploration.extrinsic.weight),
+        "multiplier": float(cfg.algo.intrinsic_reward_multiplier),
+    }
+    return base_make_train_phase(
+        fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+        cnn_keys, mlp_keys, is_continuous, p2e=p2e,
+    )
+
+
+def build_optimizers(fabric, cfg, params, saved=None):
+    wm_opt = build_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    ens_opt = build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
+    opt_state = fabric.replicate(
+        saved
+        or {
+            "world_model": wm_opt.init(params["world_model"]),
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "ensembles": ens_opt.init(params["ensembles"]),
+        }
+    )
+    return wm_opt, actor_opt, critic_opt, opt_state
+
+
+@register_algorithm(name="p2e_dv1_exploration")
+def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import dreamer_family_loop
+
+    dreamer_family_loop(
+        fabric, cfg, build_agent, make_train_phase, optimizer_builder=build_optimizers
+    )

@@ -1,0 +1,31 @@
+"""Plan2Explore over DreamerV2 — finetuning phase
+(reference: sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_agent as base_build_agent
+from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import make_train_phase as base_make_train_phase
+from sheeprl_tpu.config.compose import ConfigError
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm(name="p2e_dv2_finetuning")
+def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import dreamer_family_loop
+
+    ckpt_path = cfg.checkpoint.get("exploration_ckpt_path")
+    initial_state = None
+    if ckpt_path:
+        raw = fabric.load(ckpt_path)
+        agent = dict(raw["agent"])
+        agent.pop("ensembles", None)
+        initial_state = {"agent": agent}
+        if cfg.buffer.get("load_from_exploration", False) and "rb" in raw:
+            initial_state["rb"] = raw["rb"]
+    elif not cfg.checkpoint.resume_from:
+        raise ConfigError("p2e finetuning needs checkpoint.exploration_ckpt_path")
+    dreamer_family_loop(
+        fabric, cfg, base_build_agent, base_make_train_phase, initial_state=initial_state
+    )

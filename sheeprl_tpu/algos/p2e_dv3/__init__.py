@@ -1,0 +1,1 @@
+"""Plan2Explore (p2e_dv3)."""

@@ -1,0 +1,470 @@
+"""Plan2Explore over DreamerV3 — exploration phase
+(reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:1-1059).
+
+On top of the DreamerV3 world model:
+* an ensemble of N forward models predicting the next posterior state from
+  (latent ⊕ action), trained with MSE (reference: :207-230);
+* intrinsic reward = ensemble-prediction variance × multiplier (:262-287);
+* a DICT of exploration critics (intrinsic + extrinsic), each with its own
+  target network, Moments normalizer and advantage weight — the exploration
+  actor maximizes the weight-normalized advantage sum (:234-330);
+* the TASK actor/critic train on extrinsic rewards in parallel so the
+  finetuning phase starts from a task policy.
+
+The environment player acts with the exploration actor
+(``algo.player.actor_type``).  All of it runs inside the same
+single-dispatch scanned train phase as the rest of the Dreamer family.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.dreamer_v3.agent import (
+    Actor,
+    Critic,
+    DreamerMLP,
+    WorldModel,
+    build_agent as dv3_build_agent,
+)
+from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, moments_update
+from sheeprl_tpu.utils.distribution import Bernoulli, OneHotCategorical, TwoHotEncodingDistribution
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
+    """DV3 agent + ensembles + exploration actor + per-reward critics."""
+    world_model, actor, critic, params = dv3_build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space,
+        state if state is not None else None,
+    )
+    if state is not None:
+        return world_model, actor, critic, params
+
+    params = jax.device_get(params)
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
+    rec = wm_cfg.recurrent_model.recurrent_state_size
+    latent_dim = stoch_flat + rec
+    act_width = int(sum(actions_dim))
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    k_ens, k_actor, *k_crit = jax.random.split(key, 3 + len(cfg.algo.critics_exploration))
+
+    ens = ensemble_module(cfg)
+    ens_params = ens.init(k_ens, jnp.zeros((1, latent_dim + act_width)))
+
+    # exploration actor (same class as the task actor)
+    dummy_latent = jnp.zeros((1, latent_dim))
+    actor_expl_params = actor.init(k_actor, dummy_latent)
+
+    critics_expl: Dict[str, Any] = {}
+    for kc, name in zip(k_crit, cfg.algo.critics_exploration):
+        cp = critic.init(kc, dummy_latent)
+        critics_expl[name] = {
+            "critic": cp,
+            "target": jax.tree.map(jnp.copy, cp),
+            "moments": {"low": jnp.zeros(()), "high": jnp.zeros(())},
+        }
+
+    # task actor/critic are the dv3-built ones; the PLAYER uses "actor",
+    # which is the exploration actor during this phase
+    params = {
+        **params,
+        "actor_task": params["actor"],
+        "actor": actor_expl_params,
+        "ensembles": ens_params,
+        "critics_exploration": critics_expl,
+    }
+    return world_model, actor, critic, fabric.replicate(params)
+
+
+def ensemble_module(cfg):
+    wm_cfg = cfg.algo.world_model
+    stoch_flat = wm_cfg.stochastic_size * wm_cfg.discrete_size
+
+    class Ensembles(nn.Module):
+        """N forward models as one params-vmapped MLP stack (MXU-batched)."""
+
+        @nn.compact
+        def __call__(self, x):
+            net = nn.vmap(
+                DreamerMLP,
+                in_axes=None,
+                out_axes=0,
+                axis_size=int(cfg.algo.ensembles.n),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+            )
+            return net(
+                units=cfg.algo.ensembles.dense_units,
+                layers=cfg.algo.ensembles.mlp_layers,
+                output_dim=stoch_flat,
+                act=cfg.algo.dense_act,
+                name="ens",
+            )(x)  # (N, ..., stoch_flat)
+
+    return Ensembles()
+
+
+def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+                     cnn_keys, mlp_keys, is_continuous):
+    """DV3 world-model update + ensemble update + dual-critic exploration
+    behavior + task behavior, scanned over the update block."""
+    from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3 as dv3
+
+    base_phase_builder = dv3.make_train_phase  # reuse pieces via closures below
+    obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
+    stoch_flat = world_model.stoch_flat
+    rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    tau = float(cfg.algo.critic.tau)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    moments_cfg = cfg.algo.actor.moments
+    intrinsic_mult = float(cfg.algo.intrinsic_reward_multiplier)
+    critics_cfg = cfg.algo.critics_exploration
+    ens = ensemble_module(cfg)
+    ens_opt = build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
+
+    wm_loss_cfg = dict(
+        kl_dynamic=float(cfg.algo.world_model.kl_dynamic),
+        kl_representation=float(cfg.algo.world_model.kl_representation),
+        kl_free_nats=float(cfg.algo.world_model.kl_free_nats),
+        kl_regularizer=float(cfg.algo.world_model.kl_regularizer),
+        continue_scale_factor=float(cfg.algo.world_model.continue_scale_factor),
+    )
+
+    from sheeprl_tpu.utils.distribution import MSEDistribution, SymlogDistribution
+
+    def wm_forward(wm_params, data, k):
+        L, B = data["rewards"].shape
+        obs = {kk: data[kk] for kk in obs_keys}
+        flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
+        embed = world_model.apply(wm_params, flat_obs, method=WorldModel.encode).reshape(L, B, -1)
+        actions = jnp.concatenate([jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], 0)
+        is_first = data["is_first"].at[0].set(1.0)[..., None]
+
+        def step(carry, xs):
+            h, z = carry
+            embed_t, act_t, first_t, k_t = xs
+            h, z, post_logits, prior_logits = world_model.apply(
+                wm_params, h, z, act_t, embed_t, first_t, k_t, method=WorldModel.dynamic
+            )
+            return (h, z), (h, z, post_logits, prior_logits)
+
+        keys = jax.random.split(k, L)
+        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+            step, (jnp.zeros((B, rec_size)), jnp.zeros((B, stoch_flat))),
+            (embed, actions, is_first, keys),
+        )
+        latents = jnp.concatenate([zs, hs], -1)
+        flat_latents = latents.reshape(L * B, -1)
+        recon = world_model.apply(wm_params, flat_latents, method=WorldModel.decode)
+        obs_log_probs = {}
+        for kk in cnn_keys:
+            obs_log_probs[kk] = MSEDistribution(recon[kk].reshape(obs[kk].shape), event_dims=3).log_prob(obs[kk])
+        for kk in mlp_keys:
+            obs_log_probs[kk] = SymlogDistribution(recon[kk].reshape(L, B, -1), event_dims=1).log_prob(obs[kk])
+        reward_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.reward_logits)
+        reward_lp = TwoHotEncodingDistribution(reward_logits.reshape(L, B, -1), dims=1).log_prob(
+            data["rewards"][..., None]
+        )
+        cont_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.continue_logits)
+        cont_lp = Bernoulli(cont_logits.reshape(L, B)).log_prob(1.0 - data["terminated"])
+        loss, aux = world_model_loss(obs_log_probs, reward_lp, cont_lp, post_logits, prior_logits, **wm_loss_cfg)
+        aux["latents"] = latents
+        aux["zs"] = zs
+        aux["post_logits"] = post_logits
+        aux["prior_logits"] = prior_logits
+        return loss, aux
+
+    def imagination_rollout(wm_params, actor_params, start_latents, k):
+        def img_step(carry, k_t):
+            h, z = carry
+            latent = jnp.concatenate([z, h], -1)
+            k_a, k_z = jax.random.split(k_t)
+            head = actor.apply(actor_params, jax.lax.stop_gradient(latent))
+            action = actor.sample(head, k_a)
+            h, z = world_model.apply(wm_params, h, z, action, k_z, method=WorldModel.imagination)
+            return (h, z), (latent, action)
+
+        keys = jax.random.split(k, horizon + 1)
+        _, (traj, actions_seq) = jax.lax.scan(
+            img_step, (start_latents[:, stoch_flat:], start_latents[:, :stoch_flat]), keys
+        )
+        return traj, actions_seq
+
+    def critic_mean(critic_params, flat):
+        return TwoHotEncodingDistribution(
+            critic.apply(critic_params, flat).reshape(horizon + 1, -1, cfg.algo.critic.bins), dims=1
+        ).mean[..., 0]
+
+    def exploration_actor_update(p, o_state, latents, terminated, k):
+        n = latents.shape[0] * latents.shape[1]
+        start = jax.lax.stop_gradient(latents.reshape(n, -1))
+        weights_sum = sum(float(c["weight"]) for c in critics_cfg.values())
+
+        def actor_loss_fn(actor_params):
+            traj, actions_seq = imagination_rollout(p["world_model"], actor_params, start, k)
+            flat_traj = traj.reshape((horizon + 1) * n, -1)
+            continues = Bernoulli(
+                world_model.apply(p["world_model"], flat_traj, method=WorldModel.continue_logits)
+                .reshape(horizon + 1, n)
+            ).mode()
+            true_continue = (1.0 - terminated).reshape(1, n)
+            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, 0) / gamma)
+
+            # intrinsic reward: ensemble disagreement over next-state predictions
+            ens_in = jnp.concatenate(
+                [jax.lax.stop_gradient(traj), jax.lax.stop_gradient(actions_seq)], -1
+            )
+            preds = ens.apply(p["ensembles"], ens_in.reshape((horizon + 1) * n, -1))
+            preds = preds.reshape(int(cfg.algo.ensembles.n), horizon + 1, n, stoch_flat)
+            intrinsic = preds.var(0).mean(-1) * intrinsic_mult  # (H+1, n)
+
+            advantage = 0.0
+            aux_per_critic = {}
+            for name, ccfg in critics_cfg.items():
+                cstate = p["critics_exploration"][name]
+                values = critic_mean(cstate["critic"], flat_traj)
+                if ccfg["reward_type"] == "intrinsic":
+                    reward = intrinsic
+                else:
+                    reward = TwoHotEncodingDistribution(
+                        world_model.apply(p["world_model"], flat_traj, method=WorldModel.reward_logits)
+                        .reshape(horizon + 1, n, -1),
+                        dims=1,
+                    ).mean[..., 0]
+                lam = compute_lambda_values(reward[1:], values[1:], continues[1:] * gamma, lmbda)
+                new_moments, offset, invscale = moments_update(
+                    cstate["moments"], lam,
+                    decay=float(moments_cfg.decay), max_=float(moments_cfg.max),
+                    plow=float(moments_cfg.percentile.low), phigh=float(moments_cfg.percentile.high),
+                )
+                adv = ((lam - offset) / invscale) - ((values[:-1] - offset) / invscale)
+                advantage = advantage + adv * float(ccfg["weight"]) / weights_sum
+                aux_per_critic[name] = (lam, new_moments)
+
+            heads = actor.apply(actor_params, jax.lax.stop_gradient(traj))
+            if is_continuous:
+                objective = advantage
+            else:
+                lp = actor.log_prob(heads[:-1], jax.lax.stop_gradient(actions_seq[:-1]))
+                objective = lp * jax.lax.stop_gradient(advantage)
+            entropy = actor.entropy(heads[:-1])
+            loss = -jnp.mean(discount[:-1] * (objective + ent_coef * entropy))
+            return loss, (traj, discount, intrinsic.mean(), aux_per_critic)
+
+        (pl, (traj, discount, mean_intr, aux_pc)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(p["actor"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+        o_state = {**o_state, "actor": new_a_opt}
+
+        # per-critic regression on its own λ-targets
+        traj_sg = jax.lax.stop_gradient(traj[:-1])
+        flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
+        new_critics = {}
+        vls = 0.0
+        for name in critics_cfg:
+            lam, new_moments = aux_pc[name]
+            cstate = p["critics_exploration"][name]
+            target_mean = TwoHotEncodingDistribution(
+                critic.apply(cstate["target"], flat_sg).reshape(horizon, -1, cfg.algo.critic.bins),
+                dims=1,
+            ).mean
+
+            def c_loss(cp):
+                qv = TwoHotEncodingDistribution(
+                    critic.apply(cp, flat_sg).reshape(horizon, -1, cfg.algo.critic.bins), dims=1
+                )
+                vl = -qv.log_prob(jax.lax.stop_gradient(lam)[..., None])
+                vl = vl - qv.log_prob(jax.lax.stop_gradient(target_mean))
+                return jnp.mean(vl * discount[:-1])
+
+            vl, c_grads = jax.value_and_grad(c_loss)(cstate["critic"])
+            c_updates, new_c_opt = critic_opt.update(
+                c_grads, o_state["critics_exploration"][name], cstate["critic"]
+            )
+            new_cp = optax.apply_updates(cstate["critic"], c_updates)
+            new_critics[name] = {
+                "critic": new_cp,
+                "target": jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, cstate["target"], new_cp),
+                "moments": new_moments,
+            }
+            o_state = {
+                **o_state,
+                "critics_exploration": {**o_state["critics_exploration"], name: new_c_opt},
+            }
+            vls = vls + vl
+        p = {**p, "critics_exploration": new_critics}
+        return p, o_state, pl, vls, mean_intr
+
+    # task behavior: standard DV3 actor/critic update on extrinsic rewards
+    def task_behavior_update(p, o_state, latents, terminated, k):
+        n = latents.shape[0] * latents.shape[1]
+        start = jax.lax.stop_gradient(latents.reshape(n, -1))
+
+        def actor_loss_fn(actor_params):
+            traj, actions_seq = imagination_rollout(p["world_model"], actor_params, start, k)
+            flat_traj = traj.reshape((horizon + 1) * n, -1)
+            rewards = TwoHotEncodingDistribution(
+                world_model.apply(p["world_model"], flat_traj, method=WorldModel.reward_logits)
+                .reshape(horizon + 1, n, -1),
+                dims=1,
+            ).mean[..., 0]
+            values = critic_mean(p["critic"], flat_traj)
+            continues = Bernoulli(
+                world_model.apply(p["world_model"], flat_traj, method=WorldModel.continue_logits)
+                .reshape(horizon + 1, n)
+            ).mode()
+            true_continue = (1.0 - terminated).reshape(1, n)
+            continues = jnp.concatenate([true_continue, continues[1:]], 0)
+            lam = compute_lambda_values(rewards[1:], values[1:], continues[1:] * gamma, lmbda)
+            discount = jax.lax.stop_gradient(jnp.cumprod(continues * gamma, 0) / gamma)
+            new_moments, offset, invscale = moments_update(
+                p["moments"], lam,
+                decay=float(moments_cfg.decay), max_=float(moments_cfg.max),
+                plow=float(moments_cfg.percentile.low), phigh=float(moments_cfg.percentile.high),
+            )
+            adv = ((lam - offset) / invscale) - ((values[:-1] - offset) / invscale)
+            heads = actor.apply(actor_params, jax.lax.stop_gradient(traj))
+            if is_continuous:
+                objective = adv
+            else:
+                lp = actor.log_prob(heads[:-1], jax.lax.stop_gradient(actions_seq[:-1]))
+                objective = lp * jax.lax.stop_gradient(adv)
+            entropy = actor.entropy(heads[:-1])
+            loss = -jnp.mean(discount[:-1] * (objective + ent_coef * entropy))
+            return loss, (traj, lam, discount, new_moments)
+
+        (pl, (traj, lam, discount, new_moments)), a_grads = jax.value_and_grad(
+            actor_loss_fn, has_aux=True
+        )(p["actor_task"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor_task"], p["actor_task"])
+        p = {**p, "actor_task": optax.apply_updates(p["actor_task"], a_updates), "moments": new_moments}
+
+        traj_sg = jax.lax.stop_gradient(traj[:-1])
+        flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
+        target_mean = TwoHotEncodingDistribution(
+            critic.apply(p["target_critic"], flat_sg).reshape(horizon, -1, cfg.algo.critic.bins), dims=1
+        ).mean
+
+        def c_loss(cp):
+            qv = TwoHotEncodingDistribution(
+                critic.apply(cp, flat_sg).reshape(horizon, -1, cfg.algo.critic.bins), dims=1
+            )
+            vl = -qv.log_prob(jax.lax.stop_gradient(lam)[..., None])
+            vl = vl - qv.log_prob(jax.lax.stop_gradient(target_mean))
+            return jnp.mean(vl * discount[:-1])
+
+        vl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+        return p, {**o_state, "actor_task": new_a_opt, "critic": new_c_opt}, pl, vl
+
+    def single_update(carry, inputs):
+        p, o_state, counter = carry
+        data, k = inputs
+        k_wm, k_ens, k_expl, k_task = jax.random.split(k, 4)
+
+        (wm_l, aux), wm_grads = jax.value_and_grad(wm_forward, has_aux=True)(
+            p["world_model"], data, k_wm
+        )
+        wm_updates, new_wm_opt = wm_opt.update(wm_grads, o_state["world_model"], p["world_model"])
+        p = {**p, "world_model": optax.apply_updates(p["world_model"], wm_updates)}
+        o_state = {**o_state, "world_model": new_wm_opt}
+
+        # ensembles: predict next posterior from (latent, action) (ref :207-230)
+        latents = aux["latents"]
+        zs = aux["zs"]
+        L, B = data["rewards"].shape
+
+        def ens_loss(ep):
+            inp = jnp.concatenate(
+                [jax.lax.stop_gradient(latents), jax.lax.stop_gradient(data["actions"])], -1
+            )[:-1]
+            preds = ens.apply(ep, inp.reshape((L - 1) * B, -1))
+            target = jax.lax.stop_gradient(zs[1:]).reshape(1, (L - 1) * B, -1)
+            return jnp.mean((preds.reshape(int(cfg.algo.ensembles.n), (L - 1) * B, -1) - target) ** 2)
+
+        el, e_grads = jax.value_and_grad(ens_loss)(p["ensembles"])
+        e_updates, new_e_opt = ens_opt.update(e_grads, o_state["ensembles"], p["ensembles"])
+        p = {**p, "ensembles": optax.apply_updates(p["ensembles"], e_updates)}
+        o_state = {**o_state, "ensembles": new_e_opt}
+
+        p, o_state, pl_e, vl_e, mean_intr = exploration_actor_update(
+            p, o_state, latents, data["terminated"], k_expl
+        )
+        p, o_state, pl_t, vl_t = task_behavior_update(
+            p, o_state, latents, data["terminated"], k_task
+        )
+
+        do_ema = (counter % target_freq) == 0
+        new_target = jax.tree.map(lambda t, o: (1 - tau) * t + tau * o, p["target_critic"], p["critic"])
+        p = {
+            **p,
+            "target_critic": jax.tree.map(
+                lambda n_, o_: jnp.where(do_ema, n_, o_), new_target, p["target_critic"]
+            ),
+        }
+        post_ent = OneHotCategorical(jax.lax.stop_gradient(aux["post_logits"])).entropy().sum(-1).mean()
+        prior_ent = OneHotCategorical(jax.lax.stop_gradient(aux["prior_logits"])).entropy().sum(-1).mean()
+        metrics = (
+            wm_l, aux["observation_loss"], aux["reward_loss"], aux["kl_loss"],
+            aux["continue_loss"], aux["kl"], pl_e + pl_t, vl_e + vl_t, post_ent, prior_ent,
+        )
+        return (p, o_state, counter + 1), metrics
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, blocks, k, counter0):
+        U = blocks["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), metrics = jax.lax.scan(single_update, (p, o_state, counter0), (blocks, keys))
+        return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
+
+    return train_phase
+
+
+def build_p2e_optimizers(fabric, cfg, params, saved=None):
+    wm_opt = build_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
+    ens_opt = build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
+    opt_state = fabric.replicate(
+        saved
+        or {
+            "world_model": wm_opt.init(params["world_model"]),
+            "actor": actor_opt.init(params["actor"]),
+            "actor_task": actor_opt.init(params["actor_task"]),
+            "critic": critic_opt.init(params["critic"]),
+            "ensembles": ens_opt.init(params["ensembles"]),
+            "critics_exploration": {
+                name: critic_opt.init(c["critic"])
+                for name, c in params["critics_exploration"].items()
+            },
+        }
+    )
+    return wm_opt, actor_opt, critic_opt, opt_state
+
+
+@register_algorithm(name="p2e_dv3_exploration")
+def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import dreamer_family_loop
+
+    dreamer_family_loop(
+        fabric, cfg, build_agent, make_train_phase, optimizer_builder=build_p2e_optimizers
+    )

@@ -1,0 +1,59 @@
+"""Plan2Explore over DreamerV3 — finetuning phase
+(reference: sheeprl/algos/p2e_dv3/p2e_dv3_finetuning.py:28-477).
+
+Reloads the exploration phase's checkpoint — world model, TASK actor/critic
+(and optionally the replay buffer) — and continues with standard DreamerV3
+training on the task reward.  The reference implements the config
+inheritance in the CLI (reference: sheeprl/cli.py:117-148); here the
+exploration checkpoint is given via ``checkpoint.exploration_ckpt_path``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as dv3_build_agent
+from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
+    dreamer_family_loop,
+    make_train_phase as dv3_make_train_phase,
+)
+from sheeprl_tpu.config.compose import ConfigError
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def exploration_state_to_dv3(state: Dict[str, Any], actor_type: str = "task") -> Dict[str, Any]:
+    """Project an exploration-phase checkpoint onto the DV3 state layout."""
+    agent = dict(state.get("agent", {}))
+    chosen_actor = agent.get("actor_task") if actor_type == "task" else agent.get("actor")
+    dv3_agent = {
+        "world_model": agent["world_model"],
+        "actor": chosen_actor if chosen_actor is not None else agent["actor"],
+        "critic": agent["critic"],
+        "target_critic": agent["target_critic"],
+        "moments": agent.get("moments", {"low": 0.0, "high": 0.0}),
+    }
+    out = {"agent": dv3_agent}
+    if "rb" in state:
+        out["rb"] = state["rb"]
+    return out
+
+
+@register_algorithm(name="p2e_dv3_finetuning")
+def main(fabric: Any, cfg: Any) -> None:
+    ckpt_path = cfg.checkpoint.get("exploration_ckpt_path")
+    initial_state = None
+    if ckpt_path:
+        raw = fabric.load(ckpt_path)
+        initial_state = exploration_state_to_dv3(
+            raw, actor_type=cfg.algo.get("player", {}).get("actor_type", "task")
+        )
+        if not cfg.buffer.get("load_from_exploration", False):
+            initial_state.pop("rb", None)
+    elif not cfg.checkpoint.resume_from:
+        raise ConfigError(
+            "p2e finetuning needs checkpoint.exploration_ckpt_path "
+            "(or checkpoint.resume_from for a finetuning restart)"
+        )
+    dreamer_family_loop(
+        fabric, cfg, dv3_build_agent, dv3_make_train_phase, initial_state=initial_state
+    )

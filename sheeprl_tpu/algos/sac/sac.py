@@ -45,6 +45,18 @@ from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 @register_algorithm()
 def main(fabric: Any, cfg: Any) -> None:
+    from sheeprl_tpu.algos.sac.agent import build_agent as sac_build_agent
+
+    def plain_apply(critic, cp, o, a, k):
+        return critic.apply(cp, o, a)
+
+    sac_loop(fabric, cfg, sac_build_agent, plain_apply)
+
+
+def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> None:
+    """The SAC training engine, shared with DroQ (which injects a
+    dropout-active critic apply) — mirroring how the reference derives DroQ
+    from SAC (reference: sheeprl/algos/droq/droq.py)."""
     rank = fabric.global_rank
     key = fabric.seed_everything(cfg.seed)
 
@@ -81,7 +93,7 @@ def main(fabric: Any, cfg: Any) -> None:
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
-    actor, critic, params = build_agent(fabric, act_dim, cfg, obs_dim, state.get("agent"))
+    actor, critic, params = build_agent_fn(fabric, act_dim, cfg, obs_dim, state.get("agent"))
 
     actor_opt = build_optimizer(cfg.algo.actor.optimizer)
     critic_opt = build_optimizer(cfg.algo.critic.optimizer)
@@ -117,19 +129,19 @@ def main(fabric: Any, cfg: Any) -> None:
     def one_update(carry, batch_and_key):
         p, o_state, step_idx = carry
         batch, k = batch_and_key
-        k_next, k_pi = jax.random.split(k)
+        k_next, k_pi, k_d1, k_d2, k_d3 = jax.random.split(k, 5)
         alpha = jnp.exp(p["log_alpha"])
 
         # -- critic
         next_a, next_lp = sample_action(actor, p["actor"], batch["next_obs"], k_next)
-        target_qs = critic.apply(p["target_critic"], batch["next_obs"], next_a)
+        target_qs = critic_apply(critic, p["target_critic"], batch["next_obs"], next_a, k_d1)
         target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
         # bootstrap THROUGH time-limit truncation: only true termination cuts
         # the return (reference: sac.py:46 uses data["terminated"])
         y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * target_v
 
         def c_loss(cp):
-            qs = critic.apply(cp, batch["obs"], batch["actions"])
+            qs = critic_apply(critic, cp, batch["obs"], batch["actions"], k_d2)
             return critic_loss(qs, jax.lax.stop_gradient(y))
 
         vl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
@@ -139,7 +151,7 @@ def main(fabric: Any, cfg: Any) -> None:
         # -- actor
         def a_loss(ap):
             a, lp = sample_action(actor, ap, batch["obs"], k_pi)
-            qs = critic.apply(p["critic"], batch["obs"], a)
+            qs = critic_apply(critic, p["critic"], batch["obs"], a, k_d3)
             return actor_loss(alpha, lp, jnp.min(qs, axis=0)), lp
 
         (pl, lp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
@@ -191,6 +203,7 @@ def main(fabric: Any, cfg: Any) -> None:
     if state:
         learning_starts += start_iter
 
+    player_sync_every = int(cfg.algo.get("player_sync_every", 1))
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -275,7 +288,13 @@ def main(fabric: Any, cfg: Any) -> None:
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = fabric.to_host(params["actor"])
+                    # decoupled topology: the player keeps acting on stale
+                    # weights for player_sync_every windows while the (async)
+                    # train dispatches run — the single-controller analogue of
+                    # the reference's trainer→player broadcast cadence
+                    # (reference: sac_decoupled.py:250-305)
+                    if update % player_sync_every == 0:
+                        player_params = fabric.to_host(params["actor"])
 
         # ---------------- logging -------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -324,6 +343,8 @@ def main(fabric: Any, cfg: Any) -> None:
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
+        # the deferred-sync (decoupled) player may be stale: sync once more
+        player_params = fabric.to_host(params["actor"])
         test(actor, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

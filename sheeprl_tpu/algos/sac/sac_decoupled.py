@@ -1,0 +1,28 @@
+"""SAC, decoupled (player/trainer-overlapped) topology
+(reference: sheeprl/algos/sac/sac_decoupled.py:32-588).
+
+The reference splits rank-0 player from trainer ranks with TorchCollective
+scatter/broadcast.  Single-controller equivalent: train dispatches are
+asynchronous (the host never blocks on them), and the player's host params
+refresh only every ``algo.player_sync_every`` windows — the player interacts
+on stale weights while the device trains, exactly the reference's
+player↔trainer weight-refresh cadence without any process groups.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from sheeprl_tpu.algos.sac.agent import build_agent
+from sheeprl_tpu.algos.sac.sac import sac_loop
+from sheeprl_tpu.utils.registry import register_algorithm
+
+
+@register_algorithm(decoupled=True, name="sac_decoupled")
+def main(fabric: Any, cfg: Any) -> None:
+    cfg.algo.setdefault("player_sync_every", 10)
+
+    def plain_apply(critic, cp, o, a, k):
+        return critic.apply(cp, o, a)
+
+    sac_loop(fabric, cfg, build_agent, plain_apply)

@@ -1,0 +1,178 @@
+"""SAC-AE agent (flax) — pixel SAC with an autoencoder
+(reference: sheeprl/algos/sac_ae/agent.py:1-640).
+
+Structure: a conv (+MLP) encoder produces a feature vector shared by actor
+and critics; a decoder reconstructs observations for the autoencoder loss.
+Gradient routing mirrors the reference: the CRITIC loss backpropagates into
+the encoder, the ACTOR uses stop-gradient features, the decoder loss trains
+encoder+decoder with an L2 latent penalty.  The target critic has an EMA
+copy of both critic heads AND encoder (separate taus).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.sac.agent import SACActor, SACCriticEnsemble
+from sheeprl_tpu.models.models import CNN, DeCNN, MLP
+
+
+class AEEncoder(nn.Module):
+    """Conv encoder (+ vector branch) → LayerNorm'd feature vector."""
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    features_dim: int = 64
+    cnn_mult: int = 16
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, obs: Dict[str, jax.Array]) -> jax.Array:
+        feats = []
+        if self.cnn_keys:
+            x = jnp.concatenate([obs[k] for k in self.cnn_keys], axis=-1)
+            x = CNN(
+                channels=(self.cnn_mult, self.cnn_mult * 2, self.cnn_mult * 4),
+                kernel_sizes=4,
+                strides=2,
+                activation="relu",
+                dtype=self.dtype,
+                name="cnn",
+            )(x)
+            feats.append(x)
+        if self.mlp_keys:
+            v = jnp.concatenate([obs[k] for k in self.mlp_keys], axis=-1)
+            feats.append(
+                MLP(
+                    hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                    activation="relu",
+                    dtype=self.dtype,
+                    name="mlp",
+                )(v)
+            )
+        x = jnp.concatenate(feats, axis=-1)
+        x = nn.Dense(self.features_dim, dtype=jnp.float32, name="proj")(x)
+        x = nn.LayerNorm(name="ln")(x)
+        return jnp.tanh(x)
+
+
+class AEDecoder(nn.Module):
+    """Feature vector → per-key reconstructions."""
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_shapes: Dict[str, Tuple[int, int, int]]
+    mlp_shapes: Dict[str, int]
+    cnn_mult: int = 16
+    dense_units: int = 64
+    mlp_layers: int = 2
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            h0 = next(iter(self.cnn_shapes.values()))[0] // 8
+            total_c = sum(self.cnn_shapes[k][-1] for k in self.cnn_keys)
+            x = nn.Dense(h0 * h0 * self.cnn_mult * 4, dtype=self.dtype, name="cnn_in")(features)
+            x = nn.relu(x)
+            x = x.reshape(*x.shape[:-1], h0, h0, self.cnn_mult * 4)
+            x = DeCNN(
+                channels=(self.cnn_mult * 2, self.cnn_mult, total_c),
+                kernel_sizes=4,
+                strides=2,
+                activation="relu",
+                dtype=self.dtype,
+                name="decnn",
+            )(x)
+            start = 0
+            for k in self.cnn_keys:
+                c = self.cnn_shapes[k][-1]
+                out[k] = x[..., start:start + c]
+                start += c
+        if self.mlp_keys:
+            trunk = MLP(
+                hidden_sizes=(self.dense_units,) * self.mlp_layers,
+                activation="relu",
+                dtype=self.dtype,
+                name="mlp",
+            )(features)
+            for k in self.mlp_keys:
+                out[k] = nn.Dense(self.mlp_shapes[k], dtype=jnp.float32, name=f"head_{k}")(trunk)
+        return out
+
+
+def build_agent(
+    fabric: Any,
+    act_dim: int,
+    cfg: Any,
+    obs_space: Any,
+    state: Optional[Dict[str, Any]] = None,
+):
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    cnn_shapes = {}
+    for k in cnn_keys:
+        shape = obs_space[k].shape
+        if len(shape) == 4:
+            shape = (shape[1], shape[2], shape[0] * shape[3])
+        cnn_shapes[k] = tuple(shape)
+    mlp_shapes = {k: int(np.prod(obs_space[k].shape)) for k in mlp_keys}
+    dtype = fabric.precision.compute_dtype
+
+    encoder = AEEncoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        features_dim=cfg.algo.encoder.features_dim,
+        cnn_mult=cfg.algo.encoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.encoder.dense_units,
+        mlp_layers=cfg.algo.encoder.mlp_layers,
+        dtype=dtype,
+    )
+    decoder = AEDecoder(
+        cnn_keys=cnn_keys,
+        mlp_keys=mlp_keys,
+        cnn_shapes=cnn_shapes,
+        mlp_shapes=mlp_shapes,
+        cnn_mult=cfg.algo.decoder.cnn_channels_multiplier,
+        dense_units=cfg.algo.decoder.dense_units,
+        mlp_layers=cfg.algo.decoder.mlp_layers,
+        dtype=dtype,
+    )
+    actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.hidden_size, dtype=dtype)
+    critic = SACCriticEnsemble(
+        n_critics=cfg.algo.critic.n, hidden_size=cfg.algo.hidden_size, dtype=dtype
+    )
+
+    if state is not None:
+        params = state
+    else:
+        key = jax.random.PRNGKey(cfg.seed)
+        k_e, k_d, k_a, k_c = jax.random.split(key, 4)
+        dummy_obs = {}
+        for k in cnn_keys:
+            dummy_obs[k] = jnp.zeros((1, *cnn_shapes[k]), jnp.float32)
+        for k in mlp_keys:
+            dummy_obs[k] = jnp.zeros((1, mlp_shapes[k]), jnp.float32)
+        enc_params = encoder.init(k_e, dummy_obs)
+        feats = encoder.apply(enc_params, dummy_obs)
+        dec_params = decoder.init(k_d, feats)
+        actor_params = actor.init(k_a, feats)
+        critic_params = critic.init(k_c, feats, jnp.zeros((1, act_dim), jnp.float32))
+        params = {
+            "encoder": enc_params,
+            "decoder": dec_params,
+            "actor": actor_params,
+            "critic": critic_params,
+            "target_encoder": jax.tree.map(jnp.copy, enc_params),
+            "target_critic": jax.tree.map(jnp.copy, critic_params),
+            "log_alpha": jnp.asarray(np.log(cfg.algo.alpha.alpha), jnp.float32),
+        }
+    return encoder, decoder, actor, critic, fabric.replicate(params)

@@ -1,0 +1,26 @@
+"""SAC-AE evaluation entrypoint (reference: sheeprl/algos/sac_ae/evaluate.py)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.algos.sac_ae.utils import test
+from sheeprl_tpu.utils.env import make_env
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.registry import register_evaluation
+
+
+@register_evaluation(algorithms="sac_ae")
+def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    env = make_env(cfg, cfg.seed, 0)()
+    act_dim = int(np.prod(env.action_space.shape))
+    obs_space = env.observation_space
+    env.close()
+    encoder, decoder, actor, critic, params = build_agent(fabric, act_dim, cfg, obs_space, state["agent"])
+    host = fabric.to_host({"encoder": params["encoder"], "actor": params["actor"]})
+    test(encoder, actor, host, cfg, log_dir, logger)

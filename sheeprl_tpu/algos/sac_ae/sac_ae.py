@@ -1,0 +1,393 @@
+"""SAC-AE — pixel SAC with autoencoder
+(reference: sheeprl/algos/sac_ae/sac_ae.py:119-502).
+
+Gradient routing parity: critic loss trains critic AND encoder; actor
+trains on stop-gradient features (at its own update frequency); the decoder
+loss (MSE reconstruction + L2 latent penalty) trains encoder+decoder at its
+own frequency; target critic/encoder EMA with separate taus.  The reference
+needs ``DDPStrategy(find_unused_parameters=True)`` for this dance
+(reference: cli.py:108-116) — the functional JAX formulation has no unused-
+parameter problem: each loss differentiates exactly the param groups it
+names, update cadences are ``lax.cond`` branches inside the scanned update.
+
+Same TPU structure as SAC: host player, bulk-sampled update blocks, one
+jitted dispatch per ratio window.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from sheeprl_tpu.algos.sac.agent import ema_update, sample_action
+from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+from sheeprl_tpu.algos.sac_ae.agent import build_agent
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.optim import build_optimizer
+from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
+
+
+def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array]:
+    out = {}
+    for k in cnn_keys:
+        x = np.asarray(obs[k])
+        if x.ndim == 5:
+            b, s, h, w, c = x.shape
+            x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
+        out[k] = jnp.asarray(x, jnp.float32) / 255.0
+    for k in mlp_keys:
+        out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(np.asarray(obs[k]).shape[0], -1))
+    return out
+
+
+@register_algorithm()
+def main(fabric: Any, cfg: Any) -> None:
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    act_space = envs.single_action_space
+    if not isinstance(act_space, gym.spaces.Box):
+        raise ValueError("SAC-AE supports continuous (Box) action spaces only, like the reference")
+    obs_space = envs.single_observation_space
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    act_dim = int(np.prod(act_space.shape))
+    act_low = np.asarray(act_space.low, np.float32)
+    act_high = np.asarray(act_space.high, np.float32)
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    encoder, decoder, actor, critic, params = build_agent(
+        fabric, act_dim, cfg, obs_space, state.get("agent")
+    )
+
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer)
+    alpha_opt = build_optimizer(cfg.algo.alpha.optimizer)
+    encoder_opt = build_optimizer(cfg.algo.encoder.optimizer)
+    decoder_opt = build_optimizer(cfg.algo.decoder.optimizer)
+    opt_state = fabric.replicate(
+        state.get("opt_state")
+        or {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+            "encoder": encoder_opt.init(params["encoder"]),
+            "decoder": decoder_opt.init(params["decoder"]),
+        }
+    )
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    host = fabric.host_device
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    encoder_tau = float(cfg.algo.encoder.tau)
+    target_entropy = -float(act_dim)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    actor_freq = int(cfg.algo.actor.per_rank_update_freq)
+    decoder_freq = int(cfg.algo.decoder.per_rank_update_freq)
+    l2_lambda = float(cfg.algo.decoder.l2_lambda)
+
+    def to_env_actions(a: np.ndarray) -> np.ndarray:
+        return act_low + (a + 1.0) * 0.5 * (act_high - act_low)
+
+    @partial(jax.jit, static_argnames=("greedy",))
+    def act_fn(p, obs, k, greedy=False):
+        feats = encoder.apply(p["encoder"], obs)
+        a, _ = sample_action(actor, p["actor"], feats, k, greedy=greedy)
+        return a
+
+    player_params = fabric.to_host({"encoder": params["encoder"], "actor": params["actor"]})
+
+    # ---------------- one scanned update -------------------------------------
+    def one_update(carry, batch_and_key):
+        p, o_state, step_idx = carry
+        batch, k = batch_and_key
+        k_next, k_pi = jax.random.split(k)
+        alpha = jnp.exp(p["log_alpha"])
+        obs = {kk: batch[kk] for kk in obs_keys}
+        next_obs = {kk: batch[f"next_{kk}"] for kk in obs_keys}
+
+        # -- critic (trains critic AND encoder)
+        next_feats = encoder.apply(p["target_encoder"], next_obs)
+        next_a, next_lp = sample_action(actor, p["actor"], next_feats, k_next)
+        target_qs = critic.apply(p["target_critic"], next_feats, next_a)
+        target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
+        y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * target_v
+
+        def c_loss(cp, ep):
+            feats = encoder.apply(ep, obs)
+            qs = critic.apply(cp, feats, batch["actions"])
+            return critic_loss(qs, jax.lax.stop_gradient(y))
+
+        vl, (c_grads, e_grads) = jax.value_and_grad(c_loss, argnums=(0, 1))(
+            p["critic"], p["encoder"]
+        )
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        e_updates, new_e_opt = encoder_opt.update(e_grads, o_state["encoder"], p["encoder"])
+        p = {
+            **p,
+            "critic": optax.apply_updates(p["critic"], c_updates),
+            "encoder": optax.apply_updates(p["encoder"], e_updates),
+        }
+        o_state = {**o_state, "critic": new_c_opt, "encoder": new_e_opt}
+
+        # -- actor + temperature (every actor_freq updates, on sg features)
+        def do_actor(operand):
+            p, o_state = operand
+            feats = jax.lax.stop_gradient(encoder.apply(p["encoder"], obs))
+
+            def a_loss(ap):
+                a, lp = sample_action(actor, ap, feats, k_pi)
+                qs = critic.apply(p["critic"], feats, a)
+                return actor_loss(alpha, lp, jnp.min(qs, axis=0)), lp
+
+            (pl, lp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+            a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+            al, t_grads = jax.value_and_grad(lambda la: alpha_loss(la, lp, target_entropy))(
+                p["log_alpha"]
+            )
+            t_updates, new_t_opt = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+            p = {
+                **p,
+                "actor": optax.apply_updates(p["actor"], a_updates),
+                "log_alpha": p["log_alpha"] + t_updates,
+            }
+            return (p, {**o_state, "actor": new_a_opt, "alpha": new_t_opt}), (pl, al)
+
+        def skip_actor(operand):
+            return operand, (jnp.zeros(()), jnp.zeros(()))
+
+        (p, o_state), (pl, al) = jax.lax.cond(
+            step_idx % actor_freq == 0, do_actor, skip_actor, (p, o_state)
+        )
+
+        # -- autoencoder (every decoder_freq updates)
+        def do_decoder(operand):
+            p, o_state = operand
+
+            def d_loss(ep, dp):
+                feats = encoder.apply(ep, obs)
+                recon = decoder.apply(dp, feats)
+                loss = 0.0
+                for kk in obs_keys:
+                    target = obs[kk] - 0.5 if kk in cnn_keys else obs[kk]
+                    loss = loss + jnp.mean((recon[kk] - target) ** 2)
+                return loss + l2_lambda * jnp.mean(jnp.sum(feats**2, axis=-1))
+
+            dl, (e_grads, d_grads) = jax.value_and_grad(d_loss, argnums=(0, 1))(
+                p["encoder"], p["decoder"]
+            )
+            e_updates, new_e_opt = encoder_opt.update(e_grads, o_state["encoder"], p["encoder"])
+            d_updates, new_d_opt = decoder_opt.update(d_grads, o_state["decoder"], p["decoder"])
+            p = {
+                **p,
+                "encoder": optax.apply_updates(p["encoder"], e_updates),
+                "decoder": optax.apply_updates(p["decoder"], d_updates),
+            }
+            return (p, {**o_state, "encoder": new_e_opt, "decoder": new_d_opt}), dl
+
+        def skip_decoder(operand):
+            return operand, jnp.zeros(())
+
+        (p, o_state), dl = jax.lax.cond(
+            step_idx % decoder_freq == 0, do_decoder, skip_decoder, (p, o_state)
+        )
+
+        # -- EMA targets
+        do_ema = (step_idx % target_freq) == 0
+        new_tc = ema_update(p["target_critic"], p["critic"], tau)
+        new_te = ema_update(p["target_encoder"], p["encoder"], encoder_tau)
+        p = {
+            **p,
+            "target_critic": jax.tree.map(lambda n, o: jnp.where(do_ema, n, o), new_tc, p["target_critic"]),
+            "target_encoder": jax.tree.map(lambda n, o: jnp.where(do_ema, n, o), new_te, p["target_encoder"]),
+        }
+        return (p, o_state, step_idx + 1), (vl, pl, al, dl)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, batches, k, step0):
+        U = batches["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), losses = jax.lax.scan(one_update, (p, o_state, step0), (batches, keys))
+        return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
+
+    # ---------------- counters / buffer --------------------------------------
+    policy_steps_per_iter = num_envs
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    learning_starts = int(cfg.algo.learning_starts) // policy_steps_per_iter if not cfg.dry_run else 0
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    grad_step_counter = int(state.get("grad_steps", 0))
+    if state:
+        learning_starts += start_iter
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    rb = ReplayBuffer(
+        int(cfg.buffer.size) // num_envs,
+        num_envs,
+        memmap=cfg.buffer.memmap,
+        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+    )
+    if state and cfg.buffer.checkpoint and "rb" in state:
+        rb.load_state_dict(state["rb"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
+
+    obs, _ = envs.reset(seed=cfg.seed)
+    last_losses = None
+
+    for update in range(start_iter, total_iters + 1):
+        policy_step += num_envs
+        with timer("Time/env_interaction_time"):
+            if update <= learning_starts and not state:
+                env_actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                span = act_high - act_low
+                actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
+            else:
+                with jax.default_device(host):
+                    key, sk = jax.random.split(key)
+                    actions = np.asarray(act_fn(player_params, _prep(obs, cnn_keys, mlp_keys), sk))
+                env_actions = to_env_actions(actions)
+            next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+            dones = np.logical_or(terminated, truncated)
+
+            real_next = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+            done_idx = np.nonzero(dones)[0]
+            if done_idx.size:
+                final = final_obs_rows(info, done_idx, obs_keys)
+                if final is not None:
+                    for k in obs_keys:
+                        real_next[k][done_idx] = final[k]
+
+            step = {
+                "actions": actions[None].astype(np.float32),
+                "rewards": np.asarray(rewards, np.float32)[None, :, None],
+                "terminated": terminated.astype(np.float32)[None, :, None],
+            }
+            for k in obs_keys:
+                step[k] = np.asarray(obs[k])[None]
+                step[f"next_{k}"] = real_next[k][None]
+            rb.add(step)
+            obs = next_obs
+            for ep_ret, ep_len in episode_stats(info):
+                aggregator.update("Rewards/rew_avg", ep_ret)
+                aggregator.update("Game/ep_len_avg", ep_len)
+
+        if update >= learning_starts:
+            per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
+            if per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    sample = rb.sample(batch_size, n_samples=per_rank_gradient_steps)
+                    batches: Dict[str, jax.Array] = {
+                        "actions": jnp.asarray(sample["actions"]),
+                        "rewards": jnp.asarray(sample["rewards"][..., 0]),
+                        "terminated": jnp.asarray(sample["terminated"][..., 0]),
+                    }
+                    for k in cnn_keys:
+                        for src in (k, f"next_{k}"):
+                            x = np.asarray(sample[src])
+                            if x.ndim == 7:
+                                u, n_, b, s, h, w, c = x.shape
+                                x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u, n_, b, h, w, s * c)
+                            batches[src] = jnp.asarray(x, jnp.float32) / 255.0
+                    for k in mlp_keys:
+                        for src in (k, f"next_{k}"):
+                            x = np.asarray(sample[src], np.float32)
+                            batches[src] = jnp.asarray(x.reshape(*x.shape[:2], -1))
+                    batches = fabric.shard_batch(batches, axis=1)
+                    key, tk = jax.random.split(key)
+                    params, opt_state, last_losses = train_phase(
+                        params, opt_state, batches, tk, jnp.int32(grad_step_counter)
+                    )
+                    grad_step_counter += per_rank_gradient_steps
+                    player_params = fabric.to_host(
+                        {"encoder": params["encoder"], "actor": params["actor"]}
+                    )
+
+        if cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                vl, pl, al, dl = last_losses
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/policy_loss", pl)
+                aggregator.update("Loss/alpha_loss", al)
+                aggregator.update("Loss/reconstruction_loss", dl)
+            metrics = aggregator.compute()
+            aggregator.reset()
+            times = timer.to_dict(reset=True)
+            steps_since = max(policy_step - last_log, 1)
+            if "Time/env_interaction_time" in times:
+                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+            if "Time/train_time" in times:
+                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+            metrics.update(times)
+            if logger is not None and metrics:
+                logger.log_metrics(metrics, policy_step)
+            last_log = policy_step
+
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            ckpt_state = {
+                "agent": params,
+                "opt_state": opt_state,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "ratio": ratio.state_dict(),
+                "grad_steps": grad_step_counter,
+            }
+            fabric.call(
+                "on_checkpoint_coupled",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if cfg.buffer.checkpoint else None,
+            )
+
+    envs.close()
+    if fabric.is_global_zero and cfg.algo.run_test:
+        from sheeprl_tpu.algos.sac_ae.utils import test
+
+        test(encoder, actor, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

@@ -1,0 +1,54 @@
+"""SAC-AE support utilities (reference: sheeprl/algos/sac_ae/utils.py)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+AGGREGATOR_KEYS = {
+    "Rewards/rew_avg",
+    "Game/ep_len_avg",
+    "Loss/value_loss",
+    "Loss/policy_loss",
+    "Loss/alpha_loss",
+    "Loss/reconstruction_loss",
+}
+MODELS_TO_REGISTER = {"encoder", "decoder", "agent"}
+
+
+def test(encoder: Any, actor: Any, params: Any, cfg: Any, log_dir: str, logger: Any = None, greedy: bool = True) -> float:
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.sac.agent import sample_action
+    from sheeprl_tpu.algos.sac_ae.sac_ae import _prep
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, run_name=log_dir, prefix="test")()
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+
+    @jax.jit
+    def act(p, o, k):
+        feats = encoder.apply(p["encoder"], o)
+        a, _ = sample_action(actor, p["actor"], feats, k, greedy=greedy)
+        return a
+
+    key = jax.random.PRNGKey(cfg.seed)
+    obs, _ = env.reset(seed=cfg.seed)
+    low = np.asarray(env.action_space.low, np.float32)
+    high = np.asarray(env.action_space.high, np.float32)
+    done, cum_reward = False, 0.0
+    while not done:
+        batched = {k: np.asarray(v)[None] for k, v in obs.items()}
+        key, sk = jax.random.split(key)
+        action = np.asarray(act(params, _prep(batched, cnn_keys, mlp_keys), sk))[0]
+        scaled = low + (action + 1.0) * 0.5 * (high - low)
+        obs, reward, terminated, truncated, _ = env.step(scaled)
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
+    return cum_reward

@@ -178,15 +178,22 @@ def registration(argv: Optional[List[str]] = None) -> None:
 
     sheeprl_tpu.register_all_algorithms()
     entry = resolve_algorithm(cfg.algo.name)
-    utils_mod = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
-    log_models = getattr(utils_mod, "log_models_from_checkpoint", None)
-    if log_models is None:
-        raise ConfigError(f"Algorithm '{cfg.algo.name}' does not support model registration")
+    try:
+        utils_mod = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")
+    except ModuleNotFoundError:
+        utils_mod = None
     from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.utils.model_manager import register_model_from_checkpoint
 
     fabric = build_fabric(cfg)
     state = fabric.load(ckpt_path)
-    log_models(fabric, cfg, state)
+    log_models = getattr(utils_mod, "log_models_from_checkpoint", None)
+    if log_models is not None:
+        log_models(fabric, cfg, state)
+    else:
+        keys = getattr(utils_mod, "MODELS_TO_REGISTER", None)
+        versions = register_model_from_checkpoint(fabric, cfg, state, keys)
+        print(f"Registered models: {versions}")
 
 
 def available_agents() -> None:

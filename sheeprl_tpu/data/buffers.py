@@ -478,11 +478,25 @@ class EpisodeBuffer:
         if length < self._minimum_episode_length:
             return
         episode = {k: np.stack(v) for k, v in open_ep.items()}
+        if self._memmap:
+            ep_id = self._episode_counter = getattr(self, "_episode_counter", 0) + 1
+            episode = {
+                k: MemmapArray.from_array(
+                    v,
+                    filename=(self._memmap_dir / f"ep_{ep_id}_{k}.memmap")
+                    if self._memmap_dir is not None
+                    else None,
+                )
+                for k, v in episode.items()
+            }
         self._episodes.append(episode)
         self._stored_steps += length
         while self._stored_steps > self._buffer_size and self._episodes:
             evicted = self._episodes.pop(0)
             self._stored_steps -= len(next(iter(evicted.values())))
+            for v in evicted.values():
+                if isinstance(v, MemmapArray):
+                    v.close(delete_file=True)
 
     def sample(
         self,

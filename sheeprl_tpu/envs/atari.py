@@ -1,0 +1,40 @@
+"""Atari environments (reference: sheeprl/configs/env/atari.yaml pipeline).
+
+The reference wraps ALE envs with gymnasium's AtariPreprocessing; the same
+pipeline is reproduced here (noop resets, frame-skip via action_repeat,
+grayscale/resize handled by the shared factory).  Gated: requires
+``ale_py`` (not bundled in this image) — a clear error tells the user.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import gymnasium as gym
+
+try:
+    import ale_py  # noqa: F401
+
+    gym.register_envs(ale_py)
+    _ALE_AVAILABLE = True
+except Exception:
+    _ALE_AVAILABLE = False
+
+
+def make_atari_env(env_id: str, cfg: Any, render_mode: str = "rgb_array") -> gym.Env:
+    if not _ALE_AVAILABLE:
+        raise ImportError(
+            "Atari environments need the 'ale_py' package (pip install "
+            "gymnasium[atari]); it is not available in this image"
+        )
+    env = gym.make(env_id, render_mode=render_mode, frameskip=1)
+    env = gym.wrappers.AtariPreprocessing(
+        env,
+        noop_max=30,
+        frame_skip=cfg.env.action_repeat if cfg.env.action_repeat > 1 else 4,
+        screen_size=cfg.env.screen_size,
+        grayscale_obs=cfg.env.grayscale,
+        scale_obs=False,
+        terminal_on_life_loss=False,
+    )
+    return env

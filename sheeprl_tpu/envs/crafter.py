@@ -1,0 +1,43 @@
+"""Crafter wrapper (reference: sheeprl/envs/crafter.py:17+). Gated."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+try:
+    import crafter  # type: ignore
+
+    _CRAFTER_AVAILABLE = True
+except Exception:
+    _CRAFTER_AVAILABLE = False
+
+
+class CrafterWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+    render_mode = "rgb_array"
+
+    def __init__(self, env_id: str = "reward", screen_size: int = 64, seed: Optional[int] = None):
+        if not _CRAFTER_AVAILABLE:
+            raise ImportError(
+                "Crafter needs the 'crafter' package; it is not available in this image"
+            )
+        self._env = crafter.Env(size=(screen_size, screen_size), reward=(env_id != "nonreward"), seed=seed)
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(0, 255, (screen_size, screen_size, 3), np.uint8)}
+        )
+        self.action_space = spaces.Discrete(self._env.action_space.n)
+
+    def reset(self, *, seed=None, options=None):
+        obs = self._env.reset()
+        return {"rgb": np.asarray(obs, np.uint8)}, {}
+
+    def step(self, action):
+        obs, reward, done, info = self._env.step(int(action))
+        return {"rgb": np.asarray(obs, np.uint8)}, float(reward), bool(done), False, info
+
+    def render(self):
+        return self._env.render()

@@ -1,0 +1,24 @@
+"""DIAMBRA arcade wrapper (reference: sheeprl/envs/diambra.py:22). Gated."""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:
+    import diambra.arena  # type: ignore  # noqa: F401
+
+    _DIAMBRA_AVAILABLE = True
+except Exception:
+    _DIAMBRA_AVAILABLE = False
+
+
+class DiambraWrapper:
+    def __init__(self, *args: Any, **kwargs: Any):
+        if not _DIAMBRA_AVAILABLE:
+            raise ImportError(
+                "DIAMBRA environments need the 'diambra-arena' package and its "
+                "docker engine; they are not available in this image"
+            )
+        raise NotImplementedError(
+            "DIAMBRA support is declared but not yet implemented in this build"
+        )

@@ -1,0 +1,83 @@
+"""DeepMind Control Suite wrapper (reference: sheeprl/envs/dmc.py:49+).
+
+Wraps a dm_control task as a gymnasium env with a Dict observation space:
+proprioceptive readings flattened under ``state`` and (optionally) rendered
+pixels under ``rgb``.  Gated on ``dm_control`` availability.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
+
+try:
+    from dm_control import suite  # type: ignore
+
+    _DMC_AVAILABLE = True
+except Exception:
+    _DMC_AVAILABLE = False
+
+
+class DMCWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+    render_mode = "rgb_array"
+
+    def __init__(
+        self,
+        env_id: str,
+        seed: Optional[int] = None,
+        from_pixels: bool = True,
+        from_vectors: bool = False,
+        width: int = 64,
+        height: int = 64,
+        camera_id: int = 0,
+    ):
+        if not _DMC_AVAILABLE:
+            raise ImportError(
+                "DMC environments need the 'dm_control' package; it is not "
+                "available in this image"
+            )
+        domain, task = env_id.replace("_", " ").split(" ", 1) if "_" in env_id else env_id.split("-", 1)
+        self._env = suite.load(domain, task.replace(" ", "_"), task_kwargs={"random": seed})
+        self._from_pixels = from_pixels
+        self._from_vectors = from_vectors
+        self._width, self._height, self._camera = width, height, camera_id
+
+        act_spec = self._env.action_spec()
+        self.action_space = spaces.Box(
+            act_spec.minimum.astype(np.float32), act_spec.maximum.astype(np.float32)
+        )
+        obs_spaces: Dict[str, spaces.Space] = {}
+        if from_pixels:
+            obs_spaces["rgb"] = spaces.Box(0, 255, (height, width, 3), np.uint8)
+        if from_vectors or not from_pixels:
+            dim = int(sum(np.prod(v.shape) for v in self._env.observation_spec().values()))
+            obs_spaces["state"] = spaces.Box(-np.inf, np.inf, (dim,), np.float32)
+        self.observation_space = spaces.Dict(obs_spaces)
+
+    def _obs(self, timestep) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        if self._from_pixels:
+            out["rgb"] = self.render()
+        if "state" in self.observation_space.spaces:
+            out["state"] = np.concatenate(
+                [np.asarray(v, np.float32).reshape(-1) for v in timestep.observation.values()]
+            )
+        return out
+
+    def reset(self, *, seed=None, options=None):
+        timestep = self._env.reset()
+        return self._obs(timestep), {}
+
+    def step(self, action):
+        timestep = self._env.step(np.asarray(action))
+        reward = float(timestep.reward or 0.0)
+        terminated = timestep.last() and timestep.discount == 0.0
+        truncated = timestep.last() and not terminated
+        return self._obs(timestep), reward, terminated, truncated, {}
+
+    def render(self) -> np.ndarray:
+        return self._env.physics.render(self._height, self._width, camera_id=self._camera)

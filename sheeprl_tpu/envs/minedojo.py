@@ -1,0 +1,25 @@
+"""MineDojo wrapper (reference: sheeprl/envs/minedojo.py:56, incl. action
+masks). Gated: 'minedojo' is not available in this image."""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:
+    import minedojo  # type: ignore  # noqa: F401
+
+    _MINEDOJO_AVAILABLE = True
+except Exception:
+    _MINEDOJO_AVAILABLE = False
+
+
+class MineDojoWrapper:
+    def __init__(self, *args: Any, **kwargs: Any):
+        if not _MINEDOJO_AVAILABLE:
+            raise ImportError(
+                "MineDojo environments need the 'minedojo' package; "
+                "it is not available in this image"
+            )
+        raise NotImplementedError(
+            "MineDojo support is declared but not yet implemented in this build"
+        )

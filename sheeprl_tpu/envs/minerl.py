@@ -1,0 +1,29 @@
+"""MineRL wrapper (reference: sheeprl/envs/minerl.py:48 + custom env specs
+in sheeprl/envs/minerl_envs/, 526 LoC: CustomNavigate, CustomObtainDiamond,
+BreakSpeedMultiplier). Gated: the 'minerl' package (and its Java backend)
+is not available in this image; the wrapper surface is declared so configs
+compose and users get an actionable error."""
+
+from __future__ import annotations
+
+from typing import Any
+
+try:
+    import minerl  # type: ignore  # noqa: F401
+
+    _MINERL_AVAILABLE = True
+except Exception:
+    _MINERL_AVAILABLE = False
+
+
+class MineRLWrapper:
+    def __init__(self, *args: Any, **kwargs: Any):
+        if not _MINERL_AVAILABLE:
+            raise ImportError(
+                "MineRL environments need the 'minerl' package (plus a JDK); "
+                "they are not available in this image"
+            )
+        raise NotImplementedError(
+            "MineRL support is declared but not yet implemented in this build; "
+            "see sheeprl_tpu/envs/minerl.py"
+        )

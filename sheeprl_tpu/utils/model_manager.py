@@ -1,0 +1,145 @@
+"""Model registry (filesystem-backed).
+
+Capability parity with the reference's MLflow model manager
+(reference: sheeprl/utils/mlflow.py:36-427 — AbstractModelManager,
+register_model, register_model_from_checkpoint, transition/delete/download):
+a versioned store of named model artifacts with metadata.  MLflow is not
+available in this environment; the store is a directory tree
+
+    <registry_root>/<model_name>/v<k>/{params.pkl, meta.yaml}
+
+which covers the same lifecycle (register, list, get latest/specific
+version, transition stage, delete, download≡path).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import time
+from abc import ABC, abstractmethod
+from pathlib import Path
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+
+class AbstractModelManager(ABC):
+    """Lifecycle surface (reference: sheeprl/utils/mlflow.py:36-73)."""
+
+    @abstractmethod
+    def register_model(self, name: str, params: Any, description: str = "", metadata: Optional[Dict] = None) -> int: ...
+
+    @abstractmethod
+    def get_latest_version(self, name: str) -> Optional[int]: ...
+
+    @abstractmethod
+    def load_model(self, name: str, version: Optional[int] = None) -> Any: ...
+
+    @abstractmethod
+    def transition_model(self, name: str, version: int, stage: str) -> None: ...
+
+    @abstractmethod
+    def delete_model(self, name: str, version: Optional[int] = None) -> None: ...
+
+
+class FileSystemModelManager(AbstractModelManager):
+    def __init__(self, registry_root: str = "models_registry"):
+        self.root = Path(registry_root)
+        self.root.mkdir(parents=True, exist_ok=True)
+
+    def _model_dir(self, name: str) -> Path:
+        return self.root / name
+
+    def _versions(self, name: str) -> List[int]:
+        d = self._model_dir(name)
+        if not d.is_dir():
+            return []
+        return sorted(int(p.name[1:]) for p in d.iterdir() if p.name.startswith("v"))
+
+    def register_model(self, name: str, params: Any, description: str = "", metadata: Optional[Dict] = None) -> int:
+        import jax
+
+        version = (self.get_latest_version(name) or 0) + 1
+        vdir = self._model_dir(name) / f"v{version}"
+        vdir.mkdir(parents=True, exist_ok=True)
+        host_params = jax.device_get(params)
+        with open(vdir / "params.pkl", "wb") as f:
+            pickle.dump(host_params, f, protocol=pickle.HIGHEST_PROTOCOL)
+        with open(vdir / "meta.yaml", "w") as f:
+            yaml.safe_dump(
+                {
+                    "name": name,
+                    "version": version,
+                    "description": description,
+                    "stage": "None",
+                    "registered_at": time.strftime("%Y-%m-%d %H:%M:%S"),
+                    "metadata": metadata or {},
+                },
+                f,
+            )
+        return version
+
+    def get_latest_version(self, name: str) -> Optional[int]:
+        versions = self._versions(name)
+        return versions[-1] if versions else None
+
+    def get_model_info(self, name: str, version: Optional[int] = None) -> Dict[str, Any]:
+        version = version or self.get_latest_version(name)
+        with open(self._model_dir(name) / f"v{version}" / "meta.yaml") as f:
+            return yaml.safe_load(f)
+
+    def load_model(self, name: str, version: Optional[int] = None) -> Any:
+        version = version or self.get_latest_version(name)
+        if version is None:
+            raise FileNotFoundError(f"No registered versions of model '{name}'")
+        with open(self._model_dir(name) / f"v{version}" / "params.pkl", "rb") as f:
+            return pickle.load(f)
+
+    def transition_model(self, name: str, version: int, stage: str) -> None:
+        meta_path = self._model_dir(name) / f"v{version}" / "meta.yaml"
+        with open(meta_path) as f:
+            meta = yaml.safe_load(f)
+        meta["stage"] = stage
+        with open(meta_path, "w") as f:
+            yaml.safe_dump(meta, f)
+
+    def delete_model(self, name: str, version: Optional[int] = None) -> None:
+        import shutil
+
+        if version is None:
+            shutil.rmtree(self._model_dir(name), ignore_errors=True)
+        else:
+            shutil.rmtree(self._model_dir(name) / f"v{version}", ignore_errors=True)
+
+    def download_model(self, name: str, version: Optional[int] = None) -> str:
+        version = version or self.get_latest_version(name)
+        return str(self._model_dir(name) / f"v{version}" / "params.pkl")
+
+    def models(self) -> List[str]:
+        return sorted(p.name for p in self.root.iterdir() if p.is_dir())
+
+
+def register_model_from_checkpoint(
+    fabric: Any, cfg: Any, state: Dict[str, Any], models_keys: Optional[set] = None
+) -> Dict[str, int]:
+    """Export checkpointed sub-models to the registry
+    (reference: sheeprl/utils/mlflow.py register_model_from_checkpoint)."""
+    manager = FileSystemModelManager(cfg.get("model_manager", {}).get("registry_root", "models_registry"))
+    agent_state = state.get("agent", {})
+    models_cfg = cfg.get("model_manager", {}).get("models", {}) or {}
+    versions = {}
+    keys = models_keys or set(models_cfg) or set(agent_state if isinstance(agent_state, dict) else [])
+    for key in keys:
+        sub = agent_state.get(key) if isinstance(agent_state, dict) else None
+        if sub is None and key == "agent":
+            sub = agent_state
+        if sub is None:
+            continue
+        info = models_cfg.get(key, {}) if isinstance(models_cfg.get(key), dict) else {}
+        name = info.get("model_name", f"{cfg.algo.name}_{key}")
+        versions[key] = manager.register_model(
+            name, sub, description=info.get("description", f"{cfg.algo.name} {key}"),
+            metadata={"algo": cfg.algo.name, "env": cfg.env.id, "seed": cfg.seed},
+        )
+    return versions

@@ -252,3 +252,212 @@ def test_dreamer_v3_dry_run(tmp_path, env_id):
         ],
     )
     run(args)
+
+
+def test_droq_dry_run(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=droq",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+            "buffer.size=64",
+        ],
+    )
+    run(args)
+
+
+def test_sac_ae_dry_run(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=sac_ae",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=4",
+            "algo.learning_starts=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.cnn_channels_multiplier=4",
+            "algo.hidden_size=32",
+            "algo.encoder.features_dim=16",
+            "env.screen_size=32",
+            "env.max_episode_steps=16",
+            "buffer.size=64",
+        ],
+    )
+    run(args)
+
+
+def test_sac_decoupled_dry_run(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=sac_decoupled",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+            "buffer.size=64",
+        ],
+    )
+    run(args)
+
+
+@pytest.mark.parametrize("buffer_type", ["sequential", "episode"])
+def test_dreamer_v2_dry_run(tmp_path, buffer_type):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v2",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=8",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.dense_units=16",
+            "algo.mlp_layers=1",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.discrete_size=4",
+            "algo.world_model.stochastic_size=4",
+            f"buffer.type={buffer_type}",
+            "env.max_episode_steps=12",
+            "buffer.size=400",
+        ],
+    )
+    run(args)
+
+
+def test_dreamer_v1_dry_run(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v1",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=8",
+            "algo.learning_starts=0",
+            "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.dense_units=16",
+            "algo.mlp_layers=1",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+            "algo.world_model.stochastic_size=8",
+            "env.max_episode_steps=12",
+            "buffer.size=400",
+        ],
+    )
+    run(args)
+
+
+TINY_DV3_ARGS = [
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=8",
+    "algo.learning_starts=0",
+    "algo.horizon=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.dense_units=16",
+    "algo.mlp_layers=1",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "env.max_episode_steps=12",
+    "buffer.size=400",
+]
+
+
+def test_p2e_dv3_exploration_and_finetuning(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=p2e_dv3_exploration",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.ensembles.n=3",
+            *TINY_DV3_ARGS,
+        ],
+    )
+    run(args)
+    import glob
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    ft_args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=p2e_dv3_finetuning",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"checkpoint.exploration_ckpt_path={ckpts[0]}",
+            *TINY_DV3_ARGS,
+        ],
+    )
+    run(ft_args)
+
+
+@pytest.mark.parametrize("version", ["1", "2"])
+def test_p2e_dv12_exploration_and_finetuning(tmp_path, version):
+    tiny = [
+        "algo.per_rank_batch_size=2",
+        "algo.per_rank_sequence_length=8",
+        "algo.learning_starts=0",
+        "algo.per_rank_pretrain_steps=0",
+        "algo.horizon=4",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.world_model.encoder.cnn_channels_multiplier=4",
+        "algo.dense_units=16",
+        "algo.mlp_layers=1",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.ensembles.n=2",
+        "env.max_episode_steps=12",
+        "buffer.size=400",
+    ]
+    if version == "2":
+        tiny += ["algo.world_model.discrete_size=4", "algo.world_model.stochastic_size=4"]
+    else:
+        tiny += ["algo.world_model.stochastic_size=8"]
+    args = standard_args(
+        tmp_path,
+        extra=[f"exp=p2e_dv{version}_exploration", "env=dummy", "env.id=continuous_dummy", *tiny],
+    )
+    run(args)
+    import glob
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    run(
+        standard_args(
+            tmp_path,
+            extra=[
+                f"exp=p2e_dv{version}_finetuning",
+                "env=dummy",
+                "env.id=continuous_dummy",
+                f"checkpoint.exploration_ckpt_path={ckpts[0]}",
+                *tiny,
+            ],
+        )
+    )

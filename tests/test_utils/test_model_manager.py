@@ -1,0 +1,39 @@
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.utils.model_manager import FileSystemModelManager
+
+
+def test_register_load_roundtrip(tmp_path):
+    mm = FileSystemModelManager(tmp_path / "registry")
+    params = {"w": jnp.ones((3, 3))}
+    v1 = mm.register_model("ppo_agent", params, description="test")
+    assert v1 == 1
+    v2 = mm.register_model("ppo_agent", params)
+    assert v2 == 2
+    loaded = mm.load_model("ppo_agent")  # latest
+    assert loaded["w"].shape == (3, 3)
+    assert mm.get_latest_version("ppo_agent") == 2
+
+
+def test_transition_and_info(tmp_path):
+    mm = FileSystemModelManager(tmp_path / "registry")
+    mm.register_model("m", {"w": jnp.zeros(2)})
+    mm.transition_model("m", 1, "production")
+    assert mm.get_model_info("m", 1)["stage"] == "production"
+
+
+def test_delete(tmp_path):
+    mm = FileSystemModelManager(tmp_path / "registry")
+    mm.register_model("m", {"w": jnp.zeros(2)})
+    mm.register_model("m", {"w": jnp.zeros(2)})
+    mm.delete_model("m", 1)
+    assert mm.get_latest_version("m") == 2
+    mm.delete_model("m")
+    assert mm.get_latest_version("m") is None
+
+
+def test_missing_model_raises(tmp_path):
+    mm = FileSystemModelManager(tmp_path / "registry")
+    with pytest.raises(FileNotFoundError):
+        mm.load_model("nope")
