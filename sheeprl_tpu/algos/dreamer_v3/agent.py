@@ -174,6 +174,7 @@ class RecurrentModel(nn.Module):
 
     recurrent_size: int
     dense_units: int
+    use_pallas: bool = False  # fused VMEM-resident GRU kernel (TPU)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -182,7 +183,8 @@ class RecurrentModel(nn.Module):
         y = LayerNorm(dtype=self.dtype, eps=1e-3, name="ln")(y)
         y = nn.silu(y)
         new_h, _ = LayerNormGRUCell(
-            units=self.recurrent_size, layer_norm=True, dtype=self.dtype, name="gru"
+            units=self.recurrent_size, layer_norm=True, use_pallas=self.use_pallas,
+            dtype=self.dtype, name="gru",
         )(h, y)
         return new_h
 
@@ -211,6 +213,7 @@ class WorldModel(nn.Module):
     symlog_inputs: bool = True
     learnable_initial_state: bool = True
     decoupled_rssm: bool = False
+    use_pallas_gru: bool = False
     dtype: Any = jnp.float32
 
     @property
@@ -226,7 +229,7 @@ class WorldModel(nn.Module):
         )
         self.recurrent_model = RecurrentModel(
             recurrent_size=self.recurrent_size, dense_units=self.dense_units,
-            dtype=self.dtype, name="recurrent_model",
+            use_pallas=self.use_pallas_gru, dtype=self.dtype, name="recurrent_model",
         )
         # posterior: (h ⊕ embed) → logits; prior: h → logits
         self.representation_model = DreamerMLP(
@@ -473,6 +476,7 @@ def build_agent(
         bins=wm_cfg.reward_model.bins,
         learnable_initial_state=wm_cfg.learnable_initial_recurrent_state,
         decoupled_rssm=wm_cfg.decoupled_rssm,
+        use_pallas_gru=bool(wm_cfg.recurrent_model.get("use_pallas", False)),
         dtype=dtype,
     )
     actor = Actor(

@@ -119,9 +119,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
                      cnn_keys, mlp_keys, is_continuous):
     """DV3 world-model update + ensemble update + dual-critic exploration
     behavior + task behavior, scanned over the update block."""
-    from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3 as dv3
 
-    base_phase_builder = dv3.make_train_phase  # reuse pieces via closures below
     obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size

@@ -210,11 +210,28 @@ class LayerNormGRUCell(nn.Module):
 
     units: int
     layer_norm: bool = True
+    use_pallas: bool = False  # fused VMEM-resident Pallas kernel (TPU);
+    # NOTE: pallas and flax paths have different param layouts — pick the
+    # flag at model-creation time (checkpoints are flag-specific)
     dtype: Any = jnp.float32
     param_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        if self.use_pallas and self.layer_norm:
+            from sheeprl_tpu.ops.gru_pallas import fused_layernorm_gru
+
+            d_in = x.shape[-1] + self.units
+            w = self.param(
+                "fused_kernel",
+                nn.initializers.lecun_normal(),
+                (d_in, 3 * self.units),
+                self.param_dtype,
+            )
+            scale = self.param("ln_scale", nn.initializers.ones_init(), (3 * self.units,), self.param_dtype)
+            bias = self.param("ln_bias", nn.initializers.zeros_init(), (3 * self.units,), self.param_dtype)
+            new_h = fused_layernorm_gru(x, h, w, scale, bias).astype(self.dtype)
+            return new_h, new_h
         inp = jnp.concatenate([x.astype(self.dtype), h.astype(self.dtype)], axis=-1)
         parts = nn.Dense(
             3 * self.units,

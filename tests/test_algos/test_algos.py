@@ -461,3 +461,17 @@ def test_p2e_dv12_exploration_and_finetuning(tmp_path, version):
             ],
         )
     )
+
+
+def test_dreamer_v3_decoupled_rssm(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.world_model.decoupled_rssm=True",
+            *TINY_DV3_ARGS,
+        ],
+    )
+    run(args)

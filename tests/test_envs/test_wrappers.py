@@ -1,0 +1,148 @@
+"""Wrapper tests (reference parity: tests/test_envs/test_frame_stack.py,
+test_actions_as_observations.py, test_make_env.py)."""
+
+import gymnasium as gym
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv
+from sheeprl_tpu.envs.wrappers import (
+    ActionRepeat,
+    ActionsAsObservationWrapper,
+    FrameStack,
+    RestartOnException,
+    RewardAsObservationWrapper,
+)
+from sheeprl_tpu.utils.env import make_env
+
+
+class TestFrameStack:
+    def test_stack_shape_and_rolling(self):
+        env = FrameStack(DiscreteDummyEnv(), num_stack=4, cnn_keys=["rgb"])
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (4, 64, 64, 3)
+        # after reset all frames identical
+        assert np.all(obs["rgb"][0] == obs["rgb"][-1])
+        obs, *_ = env.step(env.action_space.sample())
+        # newest frame differs from oldest after a step
+        assert obs["rgb"][-1][0, 0, 0] != obs["rgb"][0][0, 0, 0]
+
+    def test_dilation(self):
+        env = FrameStack(DiscreteDummyEnv(), num_stack=2, cnn_keys=["rgb"], dilation=2)
+        env.reset()
+        for _ in range(4):
+            obs, *_ = env.step(env.action_space.sample())
+        # with dilation 2 the two stacked frames are 2 steps apart
+        assert int(obs["rgb"][1][0, 0, 0]) - int(obs["rgb"][0][0, 0, 0]) == 2
+
+    def test_invalid_args(self):
+        with pytest.raises(ValueError):
+            FrameStack(DiscreteDummyEnv(), num_stack=0, cnn_keys=["rgb"])
+        with pytest.raises(RuntimeError):
+            FrameStack(DiscreteDummyEnv(), num_stack=2, cnn_keys=[])
+
+
+class TestActionsAsObservation:
+    @pytest.mark.parametrize("env_cls, noop", [(DiscreteDummyEnv, 0), (ContinuousDummyEnv, [0.0, 0.0])])
+    def test_action_stack_key(self, env_cls, noop):
+        env = ActionsAsObservationWrapper(env_cls(), num_stack=3, noop=noop)
+        obs, _ = env.reset()
+        assert "action_stack" in obs
+        expected = 3 * (4 if env_cls is DiscreteDummyEnv else 2)
+        assert obs["action_stack"].shape == (expected,)
+        obs, *_ = env.step(env.action_space.sample())
+        assert obs["action_stack"].shape == (expected,)
+
+    def test_invalid_num_stack(self):
+        with pytest.raises(ValueError):
+            ActionsAsObservationWrapper(DiscreteDummyEnv(), num_stack=0, noop=0)
+
+
+class TestRestartOnException:
+    def test_restarts_crashed_env(self):
+        calls = {"n": 0}
+
+        class Crashy(DiscreteDummyEnv):
+            def step(self, action):
+                calls["n"] += 1
+                if calls["n"] == 3:
+                    raise RuntimeError("boom")
+                return super().step(action)
+
+        env = RestartOnException(lambda: Crashy(), max_restarts=2)
+        env.reset()
+        infos = []
+        for _ in range(5):
+            obs, r, term, trunc, info = env.step(env.action_space.sample())
+            infos.append(info)
+        assert any(i.get("restart_on_exception") for i in infos)
+
+    def test_gives_up_after_max_restarts(self):
+        class AlwaysCrash(DiscreteDummyEnv):
+            def step(self, action):
+                raise RuntimeError("boom")
+
+        env = RestartOnException(lambda: AlwaysCrash(), max_restarts=1, window=60.0)
+        env.reset()
+        with pytest.raises(RuntimeError):
+            for _ in range(5):
+                env.step(env.action_space.sample())
+
+
+class TestMakeEnv:
+    def _cfg(self, extra=()):
+        return compose(
+            [
+                "env=dummy",
+                "algo.name=x",
+                "algo.total_steps=1",
+                "algo.per_rank_batch_size=1",
+                *extra,
+            ]
+        )
+
+    def test_dict_obs_and_image_transform(self):
+        cfg = self._cfg(["env.screen_size=32"])
+        env = make_env(cfg, seed=3, rank=0)()
+        obs, _ = env.reset()
+        assert set(obs.keys()) == {"rgb", "state"}
+        assert obs["rgb"].shape == (32, 32, 3) and obs["rgb"].dtype == np.uint8
+
+    def test_grayscale(self):
+        cfg = self._cfg(["env.grayscale=True", "env.screen_size=32"])
+        env = make_env(cfg, seed=3, rank=0)()
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (32, 32, 1)
+
+    def test_frame_stack_and_rewards_obs(self):
+        cfg = self._cfg(["env.frame_stack=3", "env.reward_as_observation=True"])
+        env = make_env(cfg, seed=3, rank=0)()
+        obs, _ = env.reset()
+        assert obs["rgb"].shape == (3, 64, 64, 3)
+        assert "reward" in obs
+
+    def test_action_repeat(self):
+        cfg = self._cfg(["env.action_repeat=2", "env.max_episode_steps=10"])
+        env = make_env(cfg, seed=3, rank=0)()
+        env.reset()
+        obs, *_ = env.step(env.action_space.sample())
+        # dummy env counts steps; 2 inner steps per outer step
+        assert obs["state"][0] == 2
+
+    def test_vector_env_gym(self):
+        cfg = compose(
+            ["env=gym", "env.id=CartPole-v1", "env.capture_video=False",
+             "algo.name=x", "algo.total_steps=1", "algo.per_rank_batch_size=1"]
+        )
+        env = make_env(cfg, seed=0, rank=0)()
+        obs, _ = env.reset()
+        assert "state" in obs
+
+
+def test_reward_as_observation_values():
+    env = RewardAsObservationWrapper(DiscreteDummyEnv())
+    obs, _ = env.reset()
+    assert obs["reward"][0] == 0.0
+    obs, *_ = env.step(env.action_space.sample())
+    assert obs["reward"][0] == 1.0

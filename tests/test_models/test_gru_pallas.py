@@ -1,0 +1,67 @@
+"""Pallas fused LayerNorm-GRU vs the flax cell (interpret mode, no TPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.models.models import LayerNormGRUCell
+from sheeprl_tpu.ops.gru_pallas import fused_layernorm_gru
+
+
+def _flax_reference(B=12, D=24, H=32, seed=0):
+    cell = LayerNormGRUCell(units=H, layer_norm=True)
+    key = jax.random.PRNGKey(seed)
+    h0 = jax.random.normal(key, (B, H))
+    x = jax.random.normal(jax.random.fold_in(key, 1), (B, D))
+    params = cell.init(jax.random.fold_in(key, 2), h0, x)
+    ref_out, _ = cell.apply(params, h0, x)
+    inner = params["params"]
+    w = inner["fused"]["kernel"]
+    ln = inner["ln"]["LayerNorm_0"]
+    return x, h0, w, ln["scale"], ln["bias"], np.asarray(ref_out)
+
+
+def test_fused_gru_matches_flax_cell():
+    x, h0, w, scale, bias, ref = _flax_reference()
+    out = fused_layernorm_gru(x, h0, w, scale, bias, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_gru_batch_padding():
+    # batch not a multiple of the tile → padded path
+    x, h0, w, scale, bias, ref = _flax_reference(B=5)
+    out = fused_layernorm_gru(x, h0, w, scale, bias, block_b=4, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_gru_under_scan():
+    x, h0, w, scale, bias, _ = _flax_reference()
+
+    def step(h, x_t):
+        h = fused_layernorm_gru(x_t, h, w, scale, bias, interpret=True)
+        return h, h
+
+    xs = jnp.stack([x] * 4)
+    hT, hs = jax.lax.scan(step, jnp.asarray(h0), xs)
+    assert hs.shape == (4, h0.shape[0], h0.shape[1])
+    assert np.all(np.isfinite(np.asarray(hT)))
+
+
+def test_cell_use_pallas_flag():
+    cell = LayerNormGRUCell(units=16, layer_norm=True, use_pallas=True)
+    key = jax.random.PRNGKey(0)
+    h0 = jnp.zeros((4, 16))
+    x = jax.random.normal(key, (4, 8))
+    params = cell.init(key, h0, x)
+    h1, _ = cell.apply(params, h0, x)
+    assert h1.shape == (4, 16)
+    assert np.all(np.isfinite(np.asarray(h1)))
+
+
+def test_fused_gru_leading_batch_dims():
+    x, h0, w, scale, bias, ref = _flax_reference()
+    xt = jnp.stack([jnp.asarray(x)] * 2)
+    ht = jnp.stack([jnp.asarray(h0)] * 2)
+    out = fused_layernorm_gru(xt, ht, w, scale, bias, interpret=True)
+    assert out.shape == (2, h0.shape[0], h0.shape[1])
+    np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=2e-5, atol=2e-5)

@@ -1,0 +1,57 @@
+import time
+
+import numpy as np
+
+from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.timer import timer
+
+
+class TestMetricAggregator:
+    def test_modes(self):
+        agg = MetricAggregator({"m": "mean", "s": "sum", "l": "last", "mx": "max"})
+        for v in (1.0, 2.0, 3.0):
+            for k in ("m", "s", "l", "mx"):
+                agg.update(k, v)
+        out = agg.compute()
+        assert out == {"m": 2.0, "s": 6.0, "l": 3.0, "mx": 3.0}
+
+    def test_nan_and_nonscalar_dropped(self):
+        agg = MetricAggregator({"a": "mean", "b": "mean"})
+        agg.update("a", float("nan"))
+        agg.update("b", np.ones(3))  # non-scalar
+        assert agg.compute() == {}
+
+    def test_unregistered_silently_ignored_or_raises(self):
+        agg = MetricAggregator({"a": "mean"})
+        agg.update("nope", 1.0)  # raise_on_missing=False default
+        assert "nope" not in agg.compute()
+        import pytest
+
+        strict = MetricAggregator({"a": "mean"}, raise_on_missing=True)
+        with pytest.raises(KeyError):
+            strict.update("nope", 1.0)
+
+    def test_reset(self):
+        agg = MetricAggregator({"a": "mean"})
+        agg.update("a", 5.0)
+        agg.reset()
+        assert agg.compute() == {}
+
+
+class TestTimer:
+    def test_accumulates_and_resets(self):
+        timer.disabled = False
+        with timer("Time/test_phase"):
+            time.sleep(0.01)
+        with timer("Time/test_phase"):
+            time.sleep(0.01)
+        out = timer.to_dict(reset=True)
+        assert out["Time/test_phase"] >= 0.02
+        assert timer.to_dict() == {}
+
+    def test_disabled(self):
+        timer.disabled = True
+        with timer("Time/off"):
+            pass
+        assert "Time/off" not in timer.to_dict()
+        timer.disabled = False
