@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import json
 import os
-import sys
 import time
 
 BASELINE_DV3_UPDATES_PER_S = 0.5   # RTX 3080, MsPacman-100K (BASELINE.md)

@@ -15,7 +15,7 @@ posterior/prior (mean‖std) stacked where V3 returns categorical logits.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Tuple
 
 import flax.linen as nn
 import jax

@@ -17,27 +17,18 @@ with end-prioritized sampling (reference supports both for V2).
 
 from __future__ import annotations
 
-import os
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel, build_agent as dv3_build_agent
-from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, prepare_obs
-from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values
 from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical, kl_categorical
-from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
-from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
-from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
-from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
 def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):

@@ -317,6 +317,28 @@ class WorldModel(nn.Module):
         z = OneHotCategorical(post_logits, unimix=self.unimix).rsample(key)
         return h, z.reshape(B, self.stoch_flat), post_logits, prior_logits
 
+    def posterior_decoupled(self, embed: jax.Array) -> jax.Array:
+        """DecoupledRSSM posterior logits from the embedding ALONE — batched
+        over all timesteps at once (the whole point of the variant on TPU:
+        the posterior leaves the sequential scan, reference: agent.py:501-593)."""
+        return self._logits_reshape(self.representation_model(embed))
+
+    def recurrent_prior(
+        self, prev_h: jax.Array, prev_z: jax.Array, prev_action: jax.Array, is_first: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        """The only sequential piece of the DecoupledRSSM: advance the GRU and
+        predict the prior; posteriors are precomputed in parallel."""
+        B = prev_h.shape[0]
+        h0, z0 = self.initial_state(B)
+        mask = 1.0 - is_first
+        prev_h = prev_h * mask + h0 * is_first
+        prev_z = prev_z * mask + z0 * is_first
+        prev_action = prev_action * mask
+        h = self.recurrent_model(prev_h, jnp.concatenate([prev_z, prev_action], -1))
+        h = h.astype(jnp.float32)
+        prior_logits = self._logits_reshape(self.transition_model(h))
+        return h, prior_logits
+
     def imagination(
         self, prev_h: jax.Array, prev_z: jax.Array, action: jax.Array, key: jax.Array
     ) -> Tuple[jax.Array, jax.Array]:

@@ -26,15 +26,14 @@ from __future__ import annotations
 
 import os
 from functools import partial
-from typing import Any, Dict, Sequence, Tuple
+from typing import Any, Dict, Tuple
 
-import gymnasium as gym
 import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
 
-from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
 from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
     compute_lambda_values,
@@ -293,6 +292,22 @@ def dreamer_family_loop(
             dones = np.logical_or(terminated, truncated)
 
             step_data["is_first"] = np.zeros((1, num_envs), np.float32)
+
+            # env crashed + restarted: the stream broke — mark the last stored
+            # step truncated and restart the episode bookkeeping
+            # (reference: dreamer_v3.py:595-608)
+            roe = info.get("restart_on_exception")
+            if roe is not None and not isinstance(rb, EpisodeBuffer):
+                for i in np.nonzero(np.asarray(roe, bool))[0]:
+                    if dones[i]:
+                        continue
+                    sub = rb.buffer[i]
+                    if len(sub) > 0 and "truncated" in sub:
+                        tail = (sub._pos - 1) % sub.buffer_size
+                        sub._buf["truncated"][tail] = 1.0
+                        sub._buf["terminated"][tail] = 0.0
+                    step_data["is_first"][:, i] = 1.0
+
             for ep_ret, ep_len in episode_stats(info):
                 aggregator.update("Rewards/rew_avg", ep_ret)
                 aggregator.update("Game/ep_len_avg", ep_len)
@@ -477,18 +492,39 @@ def make_train_phase(
         h0 = jnp.zeros((B, rec_size))
         z0 = jnp.zeros((B, stoch_flat))
 
-        def step(carry, xs):
-            h, z = carry
-            embed_t, act_t, first_t, k_t = xs
-            h, z, post_logits, prior_logits = world_model.apply(
-                wm_params, h, z, act_t, embed_t, first_t, k_t, method=WorldModel.dynamic
-            )
-            return (h, z), (h, z, post_logits, prior_logits)
-
         keys = jax.random.split(k, L)
-        _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-            step, (h0, z0), (embed, actions, is_first, keys)
-        )
+        if world_model.decoupled_rssm:
+            # DecoupledRSSM: ALL posteriors computed and sampled in one
+            # batched pass (no h dependence); only the GRU+prior stay in the
+            # scan — a much lighter sequential step on TPU
+            post_logits = world_model.apply(
+                wm_params, embed.reshape(L * B, -1), method=WorldModel.posterior_decoupled
+            ).reshape(L, B, world_model.stochastic_size, world_model.discrete_size)
+            zs = jax.vmap(
+                lambda lg, kk: OneHotCategorical(lg, unimix=world_model.unimix).rsample(kk)
+            )(post_logits, keys).reshape(L, B, stoch_flat)
+            prev_zs = jnp.concatenate([jnp.zeros_like(zs[:1]), zs[:-1]], 0)
+
+            def step(h, xs):
+                prev_z, act_t, first_t = xs
+                h, prior_logits = world_model.apply(
+                    wm_params, h, prev_z, act_t, first_t, method=WorldModel.recurrent_prior
+                )
+                return h, (h, prior_logits)
+
+            _, (hs, prior_logits) = jax.lax.scan(step, h0, (prev_zs, actions, is_first))
+        else:
+            def step(carry, xs):
+                h, z = carry
+                embed_t, act_t, first_t, k_t = xs
+                h, z, post_logits, prior_logits = world_model.apply(
+                    wm_params, h, z, act_t, embed_t, first_t, k_t, method=WorldModel.dynamic
+                )
+                return (h, z), (h, z, post_logits, prior_logits)
+
+            _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+                step, (h0, z0), (embed, actions, is_first, keys)
+            )
         latents = jnp.concatenate([zs, hs], -1)  # (L, B, stoch+rec)
         flat_latents = latents.reshape(L * B, -1)
 

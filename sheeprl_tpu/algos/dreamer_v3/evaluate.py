@@ -3,13 +3,13 @@
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
 from sheeprl_tpu.algos.dreamer_v3.utils import test
 from sheeprl_tpu.algos.ppo.utils import spaces_to_dims
 from sheeprl_tpu.utils.env import make_env

@@ -12,7 +12,6 @@ DV3 variant, sheeprl_tpu/algos/p2e_dv3).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
@@ -30,8 +29,6 @@ def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
     )
     if state is not None:
         return world_model, actor, critic, params
-    from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration import ensemble_module
-
     ens = _ensemble(cfg, world_model)
     rec = cfg.algo.world_model.recurrent_model.recurrent_state_size
     latent_dim = world_model.stoch_flat + rec + int(sum(actions_dim))

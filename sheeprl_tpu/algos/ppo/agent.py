@@ -20,7 +20,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from sheeprl_tpu.models.models import MLP, MultiEncoder, get_activation
+from sheeprl_tpu.models.models import MLP, MultiEncoder
 from sheeprl_tpu.utils.distribution import Categorical, Normal
 
 

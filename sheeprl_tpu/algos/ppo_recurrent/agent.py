@@ -21,7 +21,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from sheeprl_tpu.models.models import MLP, LayerNorm, get_activation
+from sheeprl_tpu.models.models import MLP
 
 
 class RecurrentPPOAgent(nn.Module):

@@ -3,7 +3,6 @@
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 
 
 def critic_loss(qs: jax.Array, target: jax.Array) -> jax.Array:

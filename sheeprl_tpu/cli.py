@@ -16,7 +16,7 @@ import os
 import pathlib
 import sys
 import warnings
-from typing import Any, Dict, List, Optional
+from typing import List, Optional
 
 from sheeprl_tpu.config.compose import ConfigError, compose
 from sheeprl_tpu.utils.registry import (

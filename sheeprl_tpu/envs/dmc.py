@@ -7,7 +7,7 @@ pixels under ``rgb``.  Gated on ``dm_control`` availability.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional, Tuple
+from typing import Dict, Optional
 
 import gymnasium as gym
 import numpy as np

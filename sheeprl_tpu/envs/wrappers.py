@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import time
 from collections import deque
-from typing import Any, Callable, Dict, Optional, Sequence, SupportsFloat, Tuple
+from typing import Any, Callable, Dict, Sequence, SupportsFloat, Tuple
 
 import gymnasium as gym
 import numpy as np
@@ -111,7 +111,11 @@ class RestartOnException(gym.Wrapper):
             obs, info = self.env.reset()
             info = dict(info)
             info["restart_on_exception"] = True
-            return obs, 0.0, False, True, info
+            # NOT terminal (reference: envs/wrappers.py:87-103): reporting a
+            # done here would trigger a second autoreset and bury this info
+            # under final_info — the train loop patches its replay buffer
+            # from the top-level flag instead
+            return obs, 0.0, False, False, info
 
     def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
         try:

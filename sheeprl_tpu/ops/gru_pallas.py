@@ -23,7 +23,6 @@ flax cell; validated against it in tests/test_models/test_gru_pallas.py with
 from __future__ import annotations
 
 import functools
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,7 @@ dims follow the torch.distributions ``Independent`` convention via an
 from __future__ import annotations
 
 import math
-from typing import Any, Optional, Sequence, Tuple
+from typing import Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

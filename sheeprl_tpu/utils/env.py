@@ -144,6 +144,15 @@ def make_env(
     """Build a thunk creating one fully-wrapped environment instance."""
 
     def thunk() -> gym.Env:
+        if cfg.env.get("restart_on_exception", False):
+            # auto-recreate the WHOLE wrapped pipeline on env crashes
+            # (reference wraps every DreamerV3 thunk, dreamer_v3.py:385-400)
+            from sheeprl_tpu.envs.wrappers import RestartOnException
+
+            return RestartOnException(_build)
+        return _build()
+
+    def _build() -> gym.Env:
         capture = bool(cfg.env.capture_video) and rank == 0 and vector_env_idx == 0 and run_name is not None
         render_mode = "rgb_array" if capture else cfg.env.get("render_mode", "rgb_array")
         env = _make_base_env(cfg, seed, render_mode)

@@ -146,3 +146,34 @@ def test_reward_as_observation_values():
     assert obs["reward"][0] == 0.0
     obs, *_ = env.step(env.action_space.sample())
     assert obs["reward"][0] == 1.0
+
+
+def test_restart_flag_reaches_vector_env_top_level_info():
+    """The crash step must NOT be a done: the flag has to surface in the
+    vectorized top-level info so the Dreamer loop's buffer repair runs."""
+    from sheeprl_tpu.utils.env import vectorize
+
+    calls = {"n": 0}
+
+    class CrashOnce(DiscreteDummyEnv):
+        def step(self, action):
+            calls["n"] += 1
+            if calls["n"] == 3:
+                raise RuntimeError("boom")
+            return super().step(action)
+
+    cfg = compose(
+        ["env=dummy", "env.sync_env=True", "algo.name=x",
+         "algo.total_steps=1", "algo.per_rank_batch_size=1"]
+    )
+    envs = vectorize(cfg, [lambda: RestartOnException(lambda: CrashOnce()),
+                           lambda: RestartOnException(lambda: DiscreteDummyEnv())])
+    envs.reset(seed=0)
+    seen = False
+    for _ in range(5):
+        _, _, term, trunc, info = envs.step([envs.single_action_space.sample()] * 2)
+        roe = info.get("restart_on_exception")
+        if roe is not None and np.asarray(roe, bool).any():
+            seen = True
+            assert not term.any() and not trunc.any()
+    assert seen
