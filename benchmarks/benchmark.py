@@ -1,0 +1,23 @@
+"""Wall-clock benchmark harness (reference: benchmarks/benchmark.py:1-52).
+
+Runs any experiment config end-to-end and prints the wall-clock, e.g.:
+
+    python benchmarks/benchmark.py exp=ppo env.id=CartPole-v1 \
+        algo.total_steps=65536 metric.log_level=0 checkpoint.every=0 \
+        env.capture_video=False algo.run_test=False
+
+The driver-facing single-line JSON benchmark lives in ../bench.py.
+"""
+
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from sheeprl_tpu.cli import run
+
+if __name__ == "__main__":
+    start = time.perf_counter()
+    run(sys.argv[1:])
+    print(f"wall_clock_s: {time.perf_counter() - start:.2f}")

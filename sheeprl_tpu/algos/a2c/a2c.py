@@ -68,6 +68,7 @@ def main(fabric: Any, cfg: Any) -> None:
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
 
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
@@ -93,7 +94,7 @@ def main(fabric: Any, cfg: Any) -> None:
     @jax.jit
     def policy_step_fn(p, obs, k):
         out, value = agent.apply(p, obs)
-        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k)
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
         return actions, logprob, value[..., 0]
 
     @jax.jit
@@ -118,7 +119,7 @@ def main(fabric: Any, cfg: Any) -> None:
         def loss_fn(p):
             out, new_values = agent.apply(p, flat_obs)
             lp, ent = evaluate_actions(
-                out, rollout["actions"].reshape(T * B, -1), actions_dim, is_continuous
+                out, rollout["actions"].reshape(T * B, -1), actions_dim, is_continuous, dist_type=dist_type
             )
             pg = policy_loss(lp, advantages.reshape(-1), reduction)
             vl = value_loss(new_values[..., 0], returns.reshape(-1), reduction)

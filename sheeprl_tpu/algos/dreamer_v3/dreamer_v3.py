@@ -297,16 +297,25 @@ def dreamer_family_loop(
             # step truncated and restart the episode bookkeeping
             # (reference: dreamer_v3.py:595-608)
             roe = info.get("restart_on_exception")
-            if roe is not None and not isinstance(rb, EpisodeBuffer):
+            if roe is not None:
                 for i in np.nonzero(np.asarray(roe, bool))[0]:
                     if dones[i]:
                         continue
-                    sub = rb.buffer[i]
-                    if len(sub) > 0 and "truncated" in sub:
-                        tail = (sub._pos - 1) % sub.buffer_size
-                        sub._buf["truncated"][tail] = 1.0
-                        sub._buf["terminated"][tail] = 0.0
+                    # the stream broke: the next stored step starts a new
+                    # episode whatever the buffer type
                     step_data["is_first"][:, i] = 1.0
+                    if isinstance(rb, EpisodeBuffer):
+                        # the open episode is unfinishable — drop it
+                        rb._open[i] = None
+                    else:
+                        sub = rb.buffer[i]
+                        if len(sub) > 0 and "truncated" in sub:
+                            tail = (sub._pos - 1) % sub.buffer_size
+                            sub._buf["truncated"][tail] = 1.0
+                            sub._buf["terminated"][tail] = 0.0
+                            # the patched row must not ALSO start an episode
+                            # (reference: dreamer_v3.py:605-607)
+                            sub._buf["is_first"][tail] = 0.0
 
             for ep_ret, ep_len in episode_stats(info):
                 aggregator.update("Rewards/rew_avg", ep_ret)

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from sheeprl_tpu.models.models import MLP, MultiEncoder
-from sheeprl_tpu.utils.distribution import Categorical, Normal
+from sheeprl_tpu.utils.distribution import Categorical, Normal, TanhNormal, TruncatedNormal
 
 
 class PPOAgent(nn.Module):
@@ -85,12 +85,32 @@ def split_actor_out(
     return sections
 
 
+def continuous_dist(mean: jax.Array, log_std: jax.Array, dist_type: str = "auto"):
+    """Continuous policy distribution selected by ``cfg.distribution.type``
+    (reference surface: configs/exp/ppo.yaml ``distribution.type: auto``):
+    auto/normal → independent Gaussian, tanh_normal → squashed Gaussian,
+    trunc_normal → Normal truncated to [-1, 1]."""
+    std = jnp.exp(log_std)
+    if dist_type in ("auto", "normal"):
+        return Normal(mean, std, event_dims=1)
+    if dist_type == "tanh_normal":
+        raise ValueError(
+            "tanh_normal needs sample-time log-prob correction and is handled "
+            "directly in sample_actions/evaluate_actions, never through "
+            "continuous_dist"
+        )
+    if dist_type == "trunc_normal":
+        return TruncatedNormal(jnp.tanh(mean), std, low=-1.0, high=1.0, event_dims=1)
+    raise ValueError(f"Unknown distribution type '{dist_type}'")
+
+
 def sample_actions(
     actor_out: jax.Array,
     actions_dim: Sequence[int],
     is_continuous: bool,
     key: jax.Array,
     greedy: bool = False,
+    dist_type: str = "auto",
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Returns ``(actions, log_prob, entropy)``.
 
@@ -99,7 +119,17 @@ def sample_actions(
     """
     if is_continuous:
         mean, log_std = split_actor_out(actor_out, actions_dim, True)
-        dist = Normal(mean, jnp.exp(log_std), event_dims=1)
+        if dist_type == "tanh_normal":
+            d = TanhNormal(mean, jnp.exp(log_std), event_dims=1)
+            if greedy:
+                action = d.mode()
+                lp = jnp.zeros(action.shape[:-1])
+            else:
+                action, lp = d.sample_and_log_prob(key)
+            # entropy of the base Gaussian (squashed entropy has no closed form)
+            ent = Normal(mean, jnp.exp(log_std), event_dims=1).entropy()
+            return action, lp, ent
+        dist = continuous_dist(mean, log_std, dist_type)
         action = dist.mode() if greedy else dist.sample(key)
         return action, dist.log_prob(action), dist.entropy()
     logits = split_actor_out(actor_out, actions_dim, False)
@@ -120,11 +150,21 @@ def evaluate_actions(
     actions: jax.Array,
     actions_dim: Sequence[int],
     is_continuous: bool,
+    dist_type: str = "auto",
 ) -> Tuple[jax.Array, jax.Array]:
     """Log-prob and entropy of stored rollout actions under current params."""
     if is_continuous:
         mean, log_std = split_actor_out(actor_out, actions_dim, True)
-        dist = Normal(mean, jnp.exp(log_std), event_dims=1)
+        if dist_type == "tanh_normal":
+            from sheeprl_tpu.utils.utils import safeatanh
+
+            base = Normal(mean, jnp.exp(log_std), event_dims=1)
+            pre = safeatanh(actions)
+            lp = base.log_prob(pre) - jnp.sum(
+                jnp.log(1.0 - actions**2 + 1e-6), axis=-1
+            )
+            return lp, base.entropy()
+        dist = continuous_dist(mean, log_std, dist_type)
         return dist.log_prob(actions), dist.entropy()
     logits = split_actor_out(actor_out, actions_dim, False)
     lp = 0.0

@@ -79,6 +79,7 @@ def main(fabric: Any, cfg: Any) -> None:
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     obs_keys = cnn_keys + mlp_keys
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
 
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
@@ -102,7 +103,7 @@ def main(fabric: Any, cfg: Any) -> None:
     @jax.jit
     def policy_step_fn(p, obs, k):
         out, value = agent.apply(p, obs)
-        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k)
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
         return actions, logprob, value[..., 0]
 
     @jax.jit
@@ -112,7 +113,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     def loss_fn(p, batch, clip_coef, ent_coef):
         out, new_values = agent.apply(p, {k: batch[k] for k in obs_keys})
-        new_logprobs, entropy = evaluate_actions(out, batch["actions"], actions_dim, is_continuous)
+        new_logprobs, entropy = evaluate_actions(out, batch["actions"], actions_dim, is_continuous, dist_type=dist_type)
         adv = batch["advantages"]
         if normalize_adv:
             adv = (adv - adv.mean()) / (adv.std() + 1e-8)

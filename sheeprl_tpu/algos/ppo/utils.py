@@ -74,10 +74,12 @@ def test(agent: Any, params: Any, cfg: Any, log_dir: str, logger: Any = None, gr
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
     actions_dim, is_continuous = spaces_to_dims(env.action_space)
 
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
+
     @jax.jit
     def act(p, o, k):
         out, _ = agent.apply(p, o)
-        a, _, _ = sample_actions(out, actions_dim, is_continuous, k, greedy=greedy)
+        a, _, _ = sample_actions(out, actions_dim, is_continuous, k, greedy=greedy, dist_type=dist_type)
         return a
 
     key = jax.random.PRNGKey(cfg.seed)

@@ -475,3 +475,22 @@ def test_dreamer_v3_decoupled_rssm(tmp_path):
         ],
     )
     run(args)
+
+
+@pytest.mark.parametrize("dist_type", ["tanh_normal", "trunc_normal"])
+def test_ppo_continuous_distribution_types(tmp_path, dist_type):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=ppo",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            f"distribution.type={dist_type}",
+            "algo.rollout_steps=8",
+            "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+        ],
+    )
+    run(args)

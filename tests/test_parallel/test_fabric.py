@@ -1,0 +1,62 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.fabric import Fabric, Precision, get_single_device_fabric
+
+
+def test_precision_policies():
+    p = Precision.from_string("bf16-mixed")
+    assert p.param_dtype == jnp.float32 and p.compute_dtype == jnp.bfloat16
+    assert Precision.from_string("32-true").compute_dtype == jnp.float32
+    assert Precision.from_string("bf16-true").param_dtype == jnp.bfloat16
+    with pytest.raises(ValueError):
+        Precision.from_string("fp16-mixed")
+
+
+def test_mesh_and_sharding():
+    fab = Fabric(devices=8, accelerator="cpu")
+    assert fab.world_size == 8
+    x = fab.shard_batch(np.zeros((16, 4), np.float32))
+    assert "data" in str(x.sharding.spec)
+    y = fab.replicate(np.zeros((3,)))
+    assert y.sharding.is_fully_replicated
+
+
+def test_mesh_shape_extra_axes():
+    # {data: -1, model: 2} → 4x2 mesh; model-axis sharding available
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": -1, "model": 2})
+    assert dict(fab.mesh.shape) == {"data": 4, "model": 2}
+    w = jax.device_put(np.zeros((8, 6), np.float32), fab.sharding(None, "model"))
+    assert w.sharding.spec == jax.sharding.PartitionSpec(None, "model")
+    # a matmul with model-sharded weights executes under jit
+    x = fab.shard_batch(np.ones((8, 8), np.float32))
+    out = jax.jit(lambda a, b: a @ b)(x, w)
+    assert out.shape == (8, 6)
+
+
+def test_too_many_devices_raises():
+    with pytest.raises(ValueError):
+        Fabric(devices=64, accelerator="cpu")
+
+
+def test_single_device_fabric():
+    fab = Fabric(devices=8, accelerator="cpu")
+    single = get_single_device_fabric(fab)
+    assert single.world_size == 1
+    assert single.device == fab.device
+
+
+def test_to_host_never_aliases():
+    fab = Fabric(devices=1, accelerator="cpu")
+    x = fab.replicate(jnp.ones((4,)))
+    host_copy = fab.to_host(x)
+    assert host_copy.unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
+
+
+def test_host_collectives_single_process():
+    fab = Fabric(devices=2, accelerator="cpu")
+    assert fab.broadcast_object({"a": 1}) == {"a": 1}
+    assert fab.all_gather_object("x") == ["x"]
+    fab.barrier()  # no-op single process
