@@ -38,6 +38,7 @@ from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
     compute_lambda_values,
     moments_update,
+    normalize_obs_block,
     prepare_obs,
     test,
 )
@@ -383,7 +384,9 @@ def dreamer_family_loop(
                         if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
                             u, l, b, s, h, w, c = x.shape
                             x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u, l, b, h, w, s * c)
-                        blocks[k] = jnp.asarray(x, jnp.float32) / 255.0 - 0.5
+                        # ship uint8 (4x less H2D traffic); the train phase
+                        # normalizes on device
+                        blocks[k] = jnp.asarray(x)
                     for k in mlp_keys:
                         x = np.asarray(sample[k], np.float32)
                         blocks[k] = jnp.asarray(x.reshape(*x.shape[:3], -1))
@@ -487,7 +490,7 @@ def make_train_phase(
     def wm_forward(wm_params, data, k):
         """Encoder + RSSM scan + heads → loss and latents for behavior."""
         L, B = data["rewards"].shape
-        obs = {kk: data[kk] for kk in obs_keys}
+        obs = normalize_obs_block(data, cnn_keys, obs_keys)
         flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
         embed = world_model.apply(wm_params, flat_obs, method=WorldModel.encode)
         embed = embed.reshape(L, B, -1)

@@ -120,3 +120,17 @@ def test(
     if logger is not None:
         logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
     return cum_reward
+
+
+def normalize_obs_block(data, cnn_keys, obs_keys, offset: float = 0.5):
+    """Device-side observation normalization of a uint8-shipped replay block:
+    images → float/255 − offset, vectors → float (the jit-side twin of
+    :func:`prepare_obs`)."""
+    import jax.numpy as jnp
+
+    return {
+        kk: (data[kk].astype(jnp.float32) / 255.0 - offset)
+        if kk in cnn_keys
+        else data[kk].astype(jnp.float32)
+        for kk in obs_keys
+    }

@@ -35,7 +35,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
     build_agent as dv3_build_agent,
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, moments_update
+from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_obs_block, moments_update
 from sheeprl_tpu.utils.distribution import Bernoulli, OneHotCategorical, TwoHotEncodingDistribution
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -147,7 +147,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
 
     def wm_forward(wm_params, data, k):
         L, B = data["rewards"].shape
-        obs = {kk: data[kk] for kk in obs_keys}
+        obs = normalize_obs_block(data, cnn_keys, obs_keys)
         flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
         embed = world_model.apply(wm_params, flat_obs, method=WorldModel.encode).reshape(L, B, -1)
         actions = jnp.concatenate([jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], 0)

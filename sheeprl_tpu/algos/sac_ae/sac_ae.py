@@ -28,6 +28,7 @@ import optax
 
 from sheeprl_tpu.algos.sac.agent import ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_block
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
@@ -134,8 +135,11 @@ def main(fabric: Any, cfg: Any) -> None:
         batch, k = batch_and_key
         k_next, k_pi = jax.random.split(k)
         alpha = jnp.exp(p["log_alpha"])
-        obs = {kk: batch[kk] for kk in obs_keys}
-        next_obs = {kk: batch[f"next_{kk}"] for kk in obs_keys}
+
+        obs = normalize_obs_block(batch, cnn_keys, obs_keys, offset=0.0)
+        next_obs = normalize_obs_block(
+            {kk: batch[f"next_{kk}"] for kk in obs_keys}, cnn_keys, obs_keys, offset=0.0
+        )
 
         # -- critic (trains critic AND encoder)
         next_feats = encoder.apply(p["target_encoder"], next_obs)
@@ -326,7 +330,7 @@ def main(fabric: Any, cfg: Any) -> None:
                             if x.ndim == 7:
                                 u, n_, b, s, h, w, c = x.shape
                                 x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u, n_, b, h, w, s * c)
-                            batches[src] = jnp.asarray(x, jnp.float32) / 255.0
+                            batches[src] = jnp.asarray(x)  # uint8; /255 on device
                     for k in mlp_keys:
                         for src in (k, f"next_{k}"):
                             x = np.asarray(sample[src], np.float32)

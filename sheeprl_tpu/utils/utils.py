@@ -244,3 +244,26 @@ def dict_to_numpy(tree: Dict[str, Any]) -> Dict[str, np.ndarray]:
 
 def copy_cfg(cfg: Any) -> Any:
     return copy.deepcopy(cfg)
+
+
+def accelerator_alive(timeout_s: int = 90) -> bool:
+    """Probe the default JAX backend in a SUBPROCESS.
+
+    A wedged TPU tunnel hangs ``jax.devices()`` forever; probing in a child
+    process bounds the damage so callers (bench.py, __graft_entry__.py) can
+    fall back to CPU instead of hanging.
+    """
+    import subprocess
+    import sys
+
+    try:
+        return (
+            subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            ).returncode
+            == 0
+        )
+    except subprocess.TimeoutExpired:
+        return False
