@@ -143,15 +143,17 @@ def bench_ppo_cartpole() -> dict:
 
 
 if __name__ == "__main__":
-    from sheeprl_tpu.utils.utils import accelerator_alive
+    from sheeprl_tpu.utils.utils import accelerator_alive, force_cpu_backend
 
     platform_note = ""
-    if not accelerator_alive():
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        # explicit CPU request: honor it (the TPU plugin overrides the env
+        # var, jax.config wins) and skip the probe entirely
+        force_cpu_backend()
+    elif not accelerator_alive():
         # fall back to CPU so the bench still reports a number instead of
         # hanging; flag it in the metric name
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+        force_cpu_backend()
         platform_note = " [accelerator unreachable: CPU fallback]"
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
     result = bench_ppo_cartpole() if target == "ppo" else bench_dreamer_v3()

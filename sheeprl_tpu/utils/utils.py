@@ -246,18 +246,24 @@ def copy_cfg(cfg: Any) -> Any:
     return copy.deepcopy(cfg)
 
 
+_ACCELERATOR_ALIVE: Optional[bool] = None
+
+
 def accelerator_alive(timeout_s: int = 90) -> bool:
-    """Probe the default JAX backend in a SUBPROCESS.
+    """Probe the default JAX backend in a SUBPROCESS (memoized per process).
 
     A wedged TPU tunnel hangs ``jax.devices()`` forever; probing in a child
     process bounds the damage so callers (bench.py, __graft_entry__.py) can
     fall back to CPU instead of hanging.
     """
+    global _ACCELERATOR_ALIVE
+    if _ACCELERATOR_ALIVE is not None:
+        return _ACCELERATOR_ALIVE
     import subprocess
     import sys
 
     try:
-        return (
+        _ACCELERATOR_ALIVE = (
             subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
                 timeout=timeout_s,
@@ -266,4 +272,19 @@ def accelerator_alive(timeout_s: int = 90) -> bool:
             == 0
         )
     except subprocess.TimeoutExpired:
+        _ACCELERATOR_ALIVE = False
+    return _ACCELERATOR_ALIVE
+
+
+def force_cpu_backend() -> bool:
+    """Pin this process's default JAX backend to CPU.  Returns False (with a
+    visible warning) if backends were already initialized — in that case the
+    caller's subsequent device use may still target the accelerator."""
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+        return True
+    except Exception as e:  # pragma: no cover - depends on init order
+        print(f"[sheeprl_tpu] WARNING: could not force CPU backend: {e}", flush=True)
         return False

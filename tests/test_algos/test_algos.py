@@ -494,3 +494,24 @@ def test_ppo_continuous_distribution_types(tmp_path, dist_type):
         ],
     )
     run(args)
+
+
+def test_dreamer_v3_resume_from_checkpoint(tmp_path):
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "buffer.checkpoint=True",
+            "algo.run_test=False",
+            *TINY_DV3_ARGS,
+        ],
+    )
+    run(args)
+    import glob
+
+    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    # resume restores params/opt/counters/ratio and the replay buffer
+    run(args + [f"checkpoint.resume_from={ckpts[0]}"])
