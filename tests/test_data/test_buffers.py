@@ -193,3 +193,27 @@ class TestReviewRegressions:
         for _ in range(10):  # must never crash by picking env 1
             batch = rb.sample(4, sequence_length=8)
             assert batch["obs"].shape == (1, 8, 4, 3)
+
+
+class TestEpisodeBufferMemmap:
+    def test_memmap_commit_sample_evict(self, tmp_path):
+        eb = EpisodeBuffer(20, sequence_length=4, n_envs=1, memmap=True, memmap_dir=tmp_path / "eb")
+        def episode(length, value):
+            d = make_step(value, n_envs=1)
+            data = {k: np.repeat(v, length, axis=0) for k, v in d.items()}
+            data["dones"][-1] = 1.0
+            return data
+        eb.add(episode(8, 1.0))
+        assert list((tmp_path / "eb").glob("*.memmap"))
+        batch = eb.sample(3, sequence_length=4)
+        assert batch["obs"].shape == (1, 4, 3, 3)
+        # evict: total steps capped at 20 -> first episode's files deleted
+        eb.add(episode(8, 2.0))
+        eb.add(episode(8, 3.0))
+        files = list((tmp_path / "eb").glob("*.memmap"))
+        # only episodes still stored keep files (2 episodes x 4 keys)
+        assert len(files) == len(eb.buffer) * 4
+        # oldest-first eviction: episode value 1.0 is gone, 2.0/3.0 remain
+        kept = sorted(float(np.asarray(ep["obs"])[0, 0]) for ep in eb.buffer)
+        assert kept == [2.0, 3.0]
+        assert not list((tmp_path / "eb").glob("ep_1_*.memmap"))
