@@ -416,7 +416,13 @@ class Actor(nn.Module):
             d = dists[0]
             a = d.mode() if greedy else d.sample(key)
             if self.action_clip > 0:
-                a = jnp.clip(a, -self.action_clip, self.action_clip)
+                # Gradient-preserving scaled clip (reference: dreamer_v3/agent.py
+                # Actor.forward): a hard clip would zero d(action)/d(params) for
+                # saturated samples and cut the dynamics-backprop signal.
+                scale = jax.lax.stop_gradient(
+                    self.action_clip / jnp.maximum(self.action_clip, jnp.abs(a))
+                )
+                a = a * scale
             return a
         keys = jax.random.split(key, len(dists))
         parts = [

@@ -123,6 +123,9 @@ class ReplayBuffer:
     def __getitem__(self, key: str) -> np.ndarray:
         return np.asarray(self._buf[key])
 
+    def keys(self) -> Tuple[str, ...]:
+        return tuple(self._buf.keys())
+
     # -- write path -------------------------------------------------------
     def _allocate(self, key: str, shape: Tuple[int, ...], dtype: Any) -> None:
         full_shape = (self._buffer_size, self._n_envs) + tuple(shape)
@@ -455,7 +458,7 @@ class EpisodeBuffer:
                 break
         if done is None:
             raise ValueError("EpisodeBuffer.add requires a 'dones' or 'terminated' key")
-        if "truncated" in data and done is not None and "terminated" in data:
+        if "truncated" in data:
             done = done | data["truncated"].astype(bool)
         steps, envs = _steps_and_envs(data)
         env_sel = list(range(self._n_envs)) if indices is None else list(indices)

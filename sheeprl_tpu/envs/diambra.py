@@ -1,24 +1,196 @@
-"""DIAMBRA arcade wrapper (reference: sheeprl/envs/diambra.py:22). Gated."""
+"""DIAMBRA arcade (fighting games) suite wrapper.
+
+Behavior parity with the reference wrapper (reference:
+sheeprl/envs/diambra.py:22-146):
+
+- assembles DIAMBRA ``EnvironmentSettings`` / ``WrappersSettings`` from the
+  config (forcing 1 player, flattened obs, and the requested action space),
+  warning about and dropping settings this framework manages itself
+  (frame shape, stacking, dilation are handled by the shared wrapper
+  pipeline in ``utils/env.py``);
+- converts the backend observation space to a flat ``Dict`` of ``Box``
+  spaces: Discrete → Box(shape=(1,)) int32, MultiDiscrete → Box(shape=(n,))
+  int32, Box passthrough — so every algorithm sees a uniform dict-of-arrays
+  interface;
+- reshapes every observation to the advertised shape and stamps
+  ``info["env_domain"] = "DIAMBRA"``;
+- a round/stage end signalled via ``info["env_done"]`` counts as an episode
+  termination.
+
+The backend (``diambra`` + its docker engine) is not available in this
+image; construction goes through :func:`_make_backend` so tests can run the
+conversion logic against a mock arena.
+"""
 
 from __future__ import annotations
 
-from typing import Any
+import warnings
+from typing import Any, Dict, Optional, Tuple, Union
 
-try:
-    import diambra.arena  # type: ignore  # noqa: F401
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
 
-    _DIAMBRA_AVAILABLE = True
-except Exception:
-    _DIAMBRA_AVAILABLE = False
+from sheeprl_tpu.utils.imports import _IS_DIAMBRA_AVAILABLE
+
+_MANAGED_SETTINGS = ("frame_shape", "n_players")
+_MANAGED_WRAPPERS = ("frame_shape", "stack_frames", "dilation", "flatten")
 
 
-class DiambraWrapper:
-    def __init__(self, *args: Any, **kwargs: Any):
-        if not _DIAMBRA_AVAILABLE:
-            raise ImportError(
-                "DIAMBRA environments need the 'diambra-arena' package and its "
-                "docker engine; they are not available in this image"
-            )
-        raise NotImplementedError(
-            "DIAMBRA support is declared but not yet implemented in this build"
+def _make_backend(
+    env_id: str,
+    action_space: str,
+    screen_size: Tuple[int, int],
+    grayscale: bool,
+    repeat_action: int,
+    rank: int,
+    diambra_settings: Dict[str, Any],
+    diambra_wrappers: Dict[str, Any],
+    render_mode: str,
+    log_level: int,
+    increase_performance: bool,
+) -> Any:
+    """Assemble settings and build the raw DIAMBRA arena env."""
+    if not _IS_DIAMBRA_AVAILABLE:
+        raise ImportError(
+            "DIAMBRA environments need the 'diambra' + 'diambra-arena' packages "
+            "and the DIAMBRA docker engine; they are not available in this image"
         )
+    import diambra.arena  # type: ignore
+    from diambra.arena import EnvironmentSettings, WrappersSettings  # type: ignore
+
+    role = diambra_settings.pop("role", None)
+    if repeat_action > 1:
+        # Sticky actions and the engine's internal frame skipping compose
+        # multiplicatively; force step_ratio=1 so action_repeat means frames.
+        if diambra_settings.get("step_ratio", 6) > 1:
+            warnings.warn(
+                f"step_ratio set to 1 because action repeat is active ({repeat_action})"
+            )
+        diambra_settings["step_ratio"] = 1
+    settings = EnvironmentSettings(
+        **{
+            **diambra_settings,
+            "game_id": env_id,
+            "action_space": getattr(
+                diambra.arena.SpaceTypes, action_space, diambra.arena.SpaceTypes.DISCRETE
+            ),
+            "n_players": 1,
+            "role": getattr(diambra.arena.Roles, role) if role is not None else None,
+            "render_mode": render_mode,
+        }
+    )
+    wrappers = WrappersSettings(
+        **{**diambra_wrappers, "flatten": True, "repeat_action": repeat_action}
+    )
+    # Resizing inside the engine (settings) is cheaper than in the wrapper
+    # pipeline; increase_performance selects where the frame is shaped.
+    frame_shape = tuple(screen_size) + (int(grayscale),)
+    if increase_performance:
+        settings.frame_shape = frame_shape
+    else:
+        wrappers.frame_shape = frame_shape
+    return diambra.arena.make(
+        env_id, settings, wrappers, rank=rank, render_mode=render_mode, log_level=log_level
+    )
+
+
+def _flatten_obs_space(backend_space: Any) -> spaces.Dict:
+    """Map every sub-space to a Box so downstream code sees uniform arrays."""
+    out: Dict[str, spaces.Space] = {}
+    for key, sub in backend_space.spaces.items():
+        if isinstance(sub, spaces.Box):
+            out[key] = sub
+        elif isinstance(sub, spaces.Discrete):
+            out[key] = spaces.Box(0, int(sub.n) - 1, (1,), np.int32)
+        elif isinstance(sub, spaces.MultiDiscrete):
+            nvec = np.asarray(sub.nvec)
+            out[key] = spaces.Box(np.zeros_like(nvec), nvec - 1, (len(nvec),), np.int32)
+        else:
+            raise RuntimeError(f"Unsupported DIAMBRA observation space: {type(sub)}")
+    return spaces.Dict(out)
+
+
+class DiambraWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array", "human"]}
+
+    def __init__(
+        self,
+        id: str,
+        action_space: str = "DISCRETE",
+        screen_size: Union[int, Tuple[int, int]] = 64,
+        grayscale: bool = False,
+        repeat_action: int = 1,
+        rank: int = 0,
+        diambra_settings: Optional[Dict[str, Any]] = None,
+        diambra_wrappers: Optional[Dict[str, Any]] = None,
+        render_mode: str = "rgb_array",
+        log_level: int = 0,
+        increase_performance: bool = True,
+    ) -> None:
+        if action_space not in ("DISCRETE", "MULTI_DISCRETE"):
+            raise ValueError(
+                "action_space must be 'DISCRETE' or 'MULTI_DISCRETE', "
+                f"got {action_space!r}"
+            )
+        diambra_settings = dict(diambra_settings or {})
+        diambra_wrappers = dict(diambra_wrappers or {})
+        role = diambra_settings.get("role")
+        if role is not None and role not in ("P1", "P2"):
+            raise ValueError(f"role must be 'P1', 'P2' or None, got {role!r}")
+        for key in _MANAGED_SETTINGS:
+            if diambra_settings.pop(key, None) is not None:
+                warnings.warn(f"The DIAMBRA '{key}' setting is managed by the framework")
+        for key in _MANAGED_WRAPPERS:
+            if diambra_wrappers.pop(key, None) is not None:
+                warnings.warn(f"The DIAMBRA '{key}' wrapper is managed by the framework")
+        if isinstance(screen_size, int):
+            screen_size = (screen_size, screen_size)
+
+        self._action_type = action_space.lower()
+        self.env = _make_backend(
+            id,
+            action_space,
+            screen_size,
+            grayscale,
+            repeat_action,
+            rank,
+            diambra_settings,
+            diambra_wrappers,
+            render_mode,
+            log_level,
+            increase_performance,
+        )
+        self.action_space = self.env.action_space
+        self.observation_space = _flatten_obs_space(self.env.observation_space)
+        self._render_mode = render_mode
+
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            k: np.asarray(v).reshape(self.observation_space[k].shape) for k, v in obs.items()
+        }
+
+    def step(self, action: Any) -> Tuple[Dict[str, np.ndarray], float, bool, bool, Dict[str, Any]]:
+        if self._action_type == "discrete" and isinstance(action, np.ndarray):
+            action = int(action.squeeze().item())
+        obs, reward, terminated, truncated, info = self.env.step(action)
+        info["env_domain"] = "DIAMBRA"
+        terminated = bool(terminated) or bool(info.get("env_done", False))
+        return self._convert_obs(obs), float(reward), terminated, bool(truncated), info
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        obs, info = self.env.reset(seed=seed, options=options)
+        info["env_domain"] = "DIAMBRA"
+        return self._convert_obs(obs), info
+
+    def render(self) -> Optional[np.ndarray]:
+        return self.env.render()
+
+    def close(self) -> None:
+        self.env.close()

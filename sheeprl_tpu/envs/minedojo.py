@@ -1,25 +1,371 @@
-"""MineDojo wrapper (reference: sheeprl/envs/minedojo.py:56, incl. action
-masks). Gated: 'minedojo' is not available in this image."""
+"""MineDojo (Minecraft) suite wrapper.
+
+Behavior parity with the reference wrapper (reference:
+sheeprl/envs/minedojo.py:56-307), redesigned around a declarative compound
+action table:
+
+- The MineDojo backend takes an 8-slot MultiDiscrete action
+  ``[move, strafe, jump/sneak/sprint, pitch, yaw, functional, craft_arg,
+  inventory_slot]`` (camera bins are 15° with 12 = no rotation; functional
+  values are 1=use 2=drop 3=attack 4=craft 5=equip 6=place 7=destroy).
+  The agent instead sees a 3-slot MultiDiscrete ``[compound_action,
+  craft_item, inventory_item]`` where ``compound_action`` indexes the 19
+  curated combos in :data:`ACTION_MAP` (12 movement/camera + 7 functional).
+- Observations are converted to fixed-size vectors over the full MineDojo
+  item vocabulary: inventory counts / running max / craft deltas, one-hot
+  equipment, life stats, plus four boolean action masks (action type,
+  equip/place, destroy, craft/smelt) that policies can use to mask logits.
+- Sticky attack/jump repeat those actions for a configurable number of
+  steps, and camera pitch is clamped to ``pitch_limits``.
+
+The ``minedojo`` package (and its Java/Malmo backend) is not available in
+this image: backend construction goes through :func:`_make_backend` and the
+item vocabulary through :func:`_item_vocab`, so tests exercise the full
+conversion pipeline against a mock simulator and a tiny vocabulary.
+"""
 
 from __future__ import annotations
 
-from typing import Any
+import copy
+from typing import Any, Dict, List, Optional, Tuple
 
-try:
-    import minedojo  # type: ignore  # noqa: F401
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
 
-    _MINEDOJO_AVAILABLE = True
-except Exception:
-    _MINEDOJO_AVAILABLE = False
+from sheeprl_tpu.utils.imports import _IS_MINEDOJO_AVAILABLE
+
+CAMERA_NOOP = 12  # 25-bin camera discretization, 15° per bin
+CAMERA_DELTA_DEG = 15.0
+# functional-action slot values in the backend action vector
+FN_NOOP, FN_USE, FN_DROP, FN_ATTACK, FN_CRAFT, FN_EQUIP, FN_PLACE, FN_DESTROY = range(8)
+# backend action-vector slots
+SLOT_MOVE, SLOT_STRAFE, SLOT_JUMP, SLOT_PITCH, SLOT_YAW, SLOT_FN, SLOT_CRAFT_ARG, SLOT_INV_ARG = range(8)
 
 
-class MineDojoWrapper:
-    def __init__(self, *args: Any, **kwargs: Any):
-        if not _MINEDOJO_AVAILABLE:
-            raise ImportError(
-                "MineDojo environments need the 'minedojo' package; "
-                "it is not available in this image"
-            )
-        raise NotImplementedError(
-            "MineDojo support is declared but not yet implemented in this build"
+def _compound(move=0, strafe=0, jump=0, pitch=CAMERA_NOOP, yaw=CAMERA_NOOP, fn=FN_NOOP) -> np.ndarray:
+    return np.array([move, strafe, jump, pitch, yaw, fn, 0, 0])
+
+
+#: The 19 curated compound actions exposed to the agent.
+ACTION_MAP: Dict[int, np.ndarray] = {
+    i: a
+    for i, a in enumerate(
+        [
+            _compound(),                        # 0  no-op
+            _compound(move=1),                  # 1  forward
+            _compound(move=2),                  # 2  back
+            _compound(strafe=1),                # 3  strafe left
+            _compound(strafe=2),                # 4  strafe right
+            _compound(move=1, jump=1),          # 5  jump + forward
+            _compound(move=1, jump=2),          # 6  sneak + forward
+            _compound(move=1, jump=3),          # 7  sprint + forward
+            _compound(pitch=CAMERA_NOOP - 1),   # 8  pitch down 15°
+            _compound(pitch=CAMERA_NOOP + 1),   # 9  pitch up 15°
+            _compound(yaw=CAMERA_NOOP - 1),     # 10 yaw left 15°
+            _compound(yaw=CAMERA_NOOP + 1),     # 11 yaw right 15°
+        ]
+        + [_compound(fn=f) for f in range(FN_USE, FN_DESTROY + 1)]  # 12..18
+    )
+}
+N_MOVEMENT_ACTIONS = 12  # actions 0-11 are always legal
+
+
+def _item_vocab() -> Tuple[List[str], List[str]]:
+    """(all_items, craft_smelt_items) from the minedojo package."""
+    if not _IS_MINEDOJO_AVAILABLE:
+        raise ImportError(
+            "MineDojo environments need the 'minedojo' package (plus a JDK); "
+            "it is not available in this image"
         )
+    from minedojo.sim import ALL_CRAFT_SMELT_ITEMS, ALL_ITEMS  # type: ignore
+
+    return list(ALL_ITEMS), list(ALL_CRAFT_SMELT_ITEMS)
+
+
+def _make_backend(
+    task_id: str,
+    image_size: Tuple[int, int],
+    world_seed: Optional[int],
+    break_speed_multiplier: int,
+    **kwargs: Any,
+) -> Any:
+    """Build the raw MineDojo simulator for ``task_id``.
+
+    MineDojo mutates its global task-spec registry during ``make``; snapshot
+    and restore it so repeated constructions stay deterministic.
+    """
+    if not _IS_MINEDOJO_AVAILABLE:
+        raise ImportError(
+            "MineDojo environments need the 'minedojo' package (plus a JDK); "
+            "it is not available in this image"
+        )
+    import minedojo  # type: ignore
+    import minedojo.tasks  # type: ignore
+
+    specs_snapshot = copy.deepcopy(minedojo.tasks.ALL_TASKS_SPECS)
+    try:
+        return minedojo.make(
+            task_id=task_id,
+            image_size=image_size,
+            world_seed=world_seed,
+            fast_reset=True,
+            break_speed_multiplier=break_speed_multiplier,
+            **kwargs,
+        )
+    finally:
+        minedojo.tasks.ALL_TASKS_SPECS = specs_snapshot
+
+
+def _norm_name(item: str) -> str:
+    return "_".join(item.split(" "))
+
+
+class MineDojoWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array", "human"]}
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        **kwargs: Any,
+    ):
+        self._pitch_limits = tuple(pitch_limits)
+        self._pos: Optional[Dict[str, float]] = kwargs.get("start_position", None)
+        self._break_speed_multiplier = int(kwargs.pop("break_speed_multiplier", 100))
+        self._start_pos = copy.deepcopy(self._pos)
+        # A >1 break-speed already collapses mining to few ticks; holding the
+        # attack button down on top of it would overshoot.
+        self._sticky_attack = 0 if self._break_speed_multiplier > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+
+        if self._pos is not None and not (
+            self._pitch_limits[0] <= self._pos["pitch"] <= self._pitch_limits[1]
+        ):
+            raise ValueError(
+                f"start_position pitch {self._pos['pitch']} outside limits {self._pitch_limits}"
+            )
+
+        all_items, craft_items = _item_vocab()
+        self._item_names = all_items
+        self._n_items = len(all_items)
+        self._item_to_id = {name: i for i, name in enumerate(all_items)}
+        self._id_to_item = dict(enumerate(all_items))
+        self._n_craft = len(craft_items)
+
+        self.env = _make_backend(
+            id, (height, width), seed, self._break_speed_multiplier, **kwargs
+        )
+
+        # per-episode state filled by _convert_obs
+        self._inventory_slots: Dict[str, List[int]] = {}
+        self._slot_names: np.ndarray = np.array([], dtype=object)
+        self._inventory_max = np.zeros(self._n_items, dtype=np.float32)
+
+        self.action_space = spaces.MultiDiscrete(
+            np.array([len(ACTION_MAP), self._n_craft, self._n_items])
+        )
+        n = self._n_items
+        self.observation_space = spaces.Dict(
+            {
+                "rgb": spaces.Box(0, 255, self.env.observation_space["rgb"].shape, np.uint8),
+                "inventory": spaces.Box(0.0, np.inf, (n,), np.float32),
+                "inventory_max": spaces.Box(0.0, np.inf, (n,), np.float32),
+                "inventory_delta": spaces.Box(-np.inf, np.inf, (n,), np.float32),
+                "equipment": spaces.Box(0.0, 1.0, (n,), np.int32),
+                "life_stats": spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+                "mask_action_type": spaces.Box(0, 1, (len(ACTION_MAP),), bool),
+                "mask_equip_place": spaces.Box(0, 1, (n,), bool),
+                "mask_destroy": spaces.Box(0, 1, (n,), bool),
+                "mask_craft_smelt": spaces.Box(0, 1, (self._n_craft,), bool),
+            }
+        )
+        self._render_mode = "rgb_array"
+        self.seed(seed)
+
+    # -- gym plumbing ------------------------------------------------------
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    # -- observation conversion --------------------------------------------
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> np.ndarray:
+        """Slot-wise inventory → per-item count vector; records the slot map
+        used to translate item-indexed equip/place/destroy actions back to
+        backend slot numbers."""
+        counts = np.zeros(self._n_items, dtype=np.float32)
+        self._inventory_slots = {}
+        names = [_norm_name(item) for item in inventory["name"].tolist()]
+        self._slot_names = np.array(names, dtype=object)
+        for slot, (item, qty) in enumerate(zip(names, inventory["quantity"])):
+            self._inventory_slots.setdefault(item, []).append(slot)
+            # "air" slots report a quantity per stack-size; count slots instead
+            counts[self._item_to_id[item]] += 1.0 if item == "air" else float(qty)
+        self._inventory_max = np.maximum(counts, self._inventory_max)
+        return counts
+
+    def _convert_inventory_delta(self, delta: Dict[str, Any]) -> np.ndarray:
+        out = np.zeros(self._n_items, dtype=np.float32)
+        for names_key, qty_key, sign in (
+            ("inc_name_by_craft", "inc_quantity_by_craft", +1.0),
+            ("dec_name_by_craft", "dec_quantity_by_craft", -1.0),
+            ("inc_name_by_other", "inc_quantity_by_other", +1.0),
+            ("dec_name_by_other", "dec_quantity_by_other", -1.0),
+        ):
+            for item, qty in zip(delta[names_key], delta[qty_key]):
+                out[self._item_to_id[_norm_name(item)]] += sign * float(qty)
+        return out
+
+    def _convert_equipment(self, equipment: Dict[str, Any]) -> np.ndarray:
+        onehot = np.zeros(self._n_items, dtype=np.int32)
+        onehot[self._item_to_id[_norm_name(equipment["name"][0])]] = 1
+        return onehot
+
+    def _convert_masks(self, masks: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Backend per-slot masks → per-item masks over the full vocabulary,
+        plus the compound-action legality mask."""
+        equip_mask = np.zeros(self._n_items, dtype=bool)
+        destroy_mask = np.zeros(self._n_items, dtype=bool)
+        for name, can_equip, can_destroy in zip(self._slot_names, masks["equip"], masks["destroy"]):
+            idx = self._item_to_id[name]
+            equip_mask[idx] |= bool(can_equip)
+            destroy_mask[idx] |= bool(can_destroy)
+        fn_mask = np.asarray(masks["action_type"], dtype=bool).copy()
+        # equip/place (functional 5, 6) need at least one equippable item,
+        # destroy (functional 7) at least one destroyable one
+        fn_mask[FN_EQUIP:FN_PLACE + 1] &= bool(equip_mask.any())
+        fn_mask[FN_DESTROY] &= bool(destroy_mask.any())
+        action_type = np.concatenate(
+            [np.ones(N_MOVEMENT_ACTIONS, dtype=bool), fn_mask[FN_USE:]]
+        )
+        return {
+            "mask_action_type": action_type,
+            "mask_equip_place": equip_mask,
+            "mask_destroy": destroy_mask,
+            "mask_craft_smelt": np.asarray(masks["craft_smelt"], dtype=bool),
+        }
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        return {
+            "rgb": obs["rgb"].copy(),
+            "inventory": self._convert_inventory(obs["inventory"]),
+            "inventory_max": self._inventory_max,
+            "inventory_delta": self._convert_inventory_delta(obs["delta_inv"]),
+            "equipment": self._convert_equipment(obs["equipment"]),
+            "life_stats": np.concatenate(
+                (obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["oxygen"])
+            ).astype(np.float32),
+            **self._convert_masks(obs["masks"]),
+        }
+
+    # -- action conversion -------------------------------------------------
+    def _convert_action(self, action: np.ndarray) -> np.ndarray:
+        out = ACTION_MAP[int(action[0])].copy()
+        if self._sticky_attack:
+            if out[SLOT_FN] == FN_ATTACK:
+                self._sticky_attack_counter = self._sticky_attack - 1
+            elif out[SLOT_FN] == FN_NOOP and self._sticky_attack_counter > 0:
+                out[SLOT_FN] = FN_ATTACK
+                self._sticky_attack_counter -= 1
+            else:  # a different functional action interrupts the hold
+                self._sticky_attack_counter = 0
+        if self._sticky_jump:
+            if out[SLOT_JUMP] == 1:
+                self._sticky_jump_counter = self._sticky_jump - 1
+            elif self._sticky_jump_counter > 0 and out[SLOT_MOVE] == 0:
+                out[SLOT_JUMP] = 1
+                if out[SLOT_STRAFE] == 0:
+                    out[SLOT_MOVE] = 1  # keep moving through the held jump
+                self._sticky_jump_counter -= 1
+            elif out[SLOT_JUMP] != 1:
+                self._sticky_jump_counter = 0
+        # argument slots only accompany their functional action
+        out[SLOT_CRAFT_ARG] = int(action[1]) if out[SLOT_FN] == FN_CRAFT else 0
+        if out[SLOT_FN] in (FN_EQUIP, FN_PLACE, FN_DESTROY):
+            slots = self._inventory_slots.get(self._id_to_item[int(action[2])], [0])
+            out[SLOT_INV_ARG] = slots[0]
+        else:
+            out[SLOT_INV_ARG] = 0
+        return out
+
+    # -- env API -----------------------------------------------------------
+    def step(self, action: np.ndarray) -> Tuple[Any, float, bool, bool, Dict[str, Any]]:
+        raw = np.asarray(action)
+        converted = self._convert_action(raw)
+        next_pitch = self._pos["pitch"] + (converted[SLOT_PITCH] - CAMERA_NOOP) * CAMERA_DELTA_DEG
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted[SLOT_PITCH] = CAMERA_NOOP
+
+        obs, reward, done, info = self.env.step(converted)
+        timed_out = bool(info.get("TimeLimit.truncated", False))
+        self._pos = self._location_stats(obs)
+        info = dict(info)
+        info.update(
+            {
+                "life_stats": {
+                    "life": float(obs["life_stats"]["life"].item()),
+                    "oxygen": float(obs["life_stats"]["oxygen"].item()),
+                    "food": float(obs["life_stats"]["food"].item()),
+                },
+                "location_stats": copy.deepcopy(self._pos),
+                "action": raw.tolist(),
+                "biomeid": float(obs["location_stats"]["biome_id"].item()),
+            }
+        )
+        return (
+            self._convert_obs(obs),
+            float(reward),
+            bool(done) and not timed_out,
+            bool(done) and timed_out,
+            info,
+        )
+
+    @staticmethod
+    def _location_stats(obs: Dict[str, Any]) -> Dict[str, float]:
+        loc = obs["location_stats"]
+        return {
+            "x": float(loc["pos"][0]),
+            "y": float(loc["pos"][1]),
+            "z": float(loc["pos"][2]),
+            "pitch": float(loc["pitch"].item()),
+            "yaw": float(loc["yaw"].item()),
+        }
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+        obs = self.env.reset()
+        self._pos = self._location_stats(obs)
+        self._sticky_jump_counter = 0
+        self._sticky_attack_counter = 0
+        self._inventory_max = np.zeros(self._n_items, dtype=np.float32)
+        info = {
+            "life_stats": {
+                "life": float(obs["life_stats"]["life"].item()),
+                "oxygen": float(obs["life_stats"]["oxygen"].item()),
+                "food": float(obs["life_stats"]["food"].item()),
+            },
+            "location_stats": copy.deepcopy(self._pos),
+            "biomeid": float(obs["location_stats"]["biome_id"].item()),
+        }
+        return self._convert_obs(obs), info
+
+    def render(self) -> Optional[np.ndarray]:
+        if self._render_mode == "rgb_array":
+            prev = getattr(self.env.unwrapped, "_prev_obs", None)
+            return None if prev is None else prev["rgb"]
+        return self.env.render()
+
+    def close(self) -> None:
+        self.env.close()

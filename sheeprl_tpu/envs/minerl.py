@@ -1,29 +1,271 @@
-"""MineRL wrapper (reference: sheeprl/envs/minerl.py:48 + custom env specs
-in sheeprl/envs/minerl_envs/, 526 LoC: CustomNavigate, CustomObtainDiamond,
-BreakSpeedMultiplier). Gated: the 'minerl' package (and its Java backend)
-is not available in this image; the wrapper surface is declared so configs
-compose and users get an actionable error."""
+"""MineRL (Minecraft, v0.4.4 line) suite wrapper.
+
+Behavior parity with the reference wrapper (reference:
+sheeprl/envs/minerl.py:48-322) over the custom task specs in
+:mod:`sheeprl_tpu.envs.minerl_envs`:
+
+- The MineRL backend takes a *dict* action (keyboard flags, a continuous
+  camera pair, and enum actions like ``craft``/``place``).  The agent sees a
+  single ``Discrete`` space instead: action 0 is the no-op and every further
+  index is one backend primitive — each binary key, each 15° camera turn
+  (pitch ±, yaw ±), and each non-"none" value of each enum action.  The map
+  is *enumerated from the backend action space*, so it adapts to whatever
+  action set the chosen task exposes; jump/sneak/sprint also press forward.
+- Sticky attack/jump hold those keys for a configurable number of steps
+  (attack also releases jump while held).
+- Camera pitch is clamped to ``pitch_limits``; yaw wraps to [-180, 180].
+- Observations become fixed-size vectors: inventory counts and their
+  running max (over the full Minecraft item vocabulary when
+  ``multihot_inventory`` else over the task's own item list), one-hot
+  mainhand equipment, life stats ``[life, food, oxygen]``, and the compass
+  angle for navigate tasks.  Frames stay channel-last ``(H, W, 3)`` uint8
+  (the TPU-native NHWC layout; the reference transposes to torch's CHW).
+
+The ``minerl`` package (plus JDK) is not available in this image: backend
+construction goes through :func:`_make_backend` and the item vocabulary
+through :func:`_item_vocab`, so tests exercise the conversion pipeline
+against a mock backend with duck-typed enum spaces.
+"""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict, List, Optional, Tuple
 
-try:
-    import minerl  # type: ignore  # noqa: F401
+import gymnasium as gym
+import numpy as np
+from gymnasium import spaces
 
-    _MINERL_AVAILABLE = True
-except Exception:
-    _MINERL_AVAILABLE = False
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+
+CAMERA_DELTA_DEG = 15.0
+#: camera primitives appended for the "camera" action key
+_CAMERA_TURNS = (
+    np.array([-CAMERA_DELTA_DEG, 0.0]),  # pitch down
+    np.array([+CAMERA_DELTA_DEG, 0.0]),  # pitch up
+    np.array([0.0, -CAMERA_DELTA_DEG]),  # yaw left
+    np.array([0.0, +CAMERA_DELTA_DEG]),  # yaw right
+)
+_NONE = "none"
 
 
-class MineRLWrapper:
-    def __init__(self, *args: Any, **kwargs: Any):
-        if not _MINERL_AVAILABLE:
-            raise ImportError(
-                "MineRL environments need the 'minerl' package (plus a JDK); "
-                "they are not available in this image"
-            )
-        raise NotImplementedError(
-            "MineRL support is declared but not yet implemented in this build; "
-            "see sheeprl_tpu/envs/minerl.py"
+def _item_vocab() -> List[str]:
+    """The full Minecraft item vocabulary (multihot inventory mode)."""
+    if not _IS_MINERL_AVAILABLE:
+        raise ImportError(
+            "MineRL environments need the 'minerl' package (plus a JDK); "
+            "it is not available in this image"
         )
+    from minerl.herobraine.hero import mc  # type: ignore
+
+    return list(mc.ALL_ITEMS)
+
+
+def _make_backend(task_id: str, break_speed: int, **kwargs: Any) -> Any:
+    """Instantiate one of the custom task specs and build its backend env."""
+    if not _IS_MINERL_AVAILABLE:
+        raise ImportError(
+            "MineRL environments need the 'minerl' package (plus a JDK); "
+            "it is not available in this image"
+        )
+    from sheeprl_tpu.envs.minerl_envs.navigate import CustomNavigate
+    from sheeprl_tpu.envs.minerl_envs.obtain import CustomObtainDiamond, CustomObtainIronPickaxe
+
+    custom_envs = {
+        "custom_navigate": CustomNavigate,
+        "custom_obtain_diamond": CustomObtainDiamond,
+        "custom_obtain_iron_pickaxe": CustomObtainIronPickaxe,
+    }
+    return custom_envs[task_id.lower()](break_speed=break_speed, **kwargs).make()
+
+
+def _is_enum_space(space: Any) -> bool:
+    """MineRL enum actions expose their string vocabulary via ``.values``."""
+    return hasattr(space, "values") and not isinstance(space, spaces.Box)
+
+
+def build_action_map(action_space: Any) -> Tuple[Dict[int, Dict[str, Any]], Dict[str, Any]]:
+    """Enumerate the backend's dict action space into (discrete map, noop).
+
+    Returns ``(actions_map, noop)`` where ``actions_map[i]`` is the dict of
+    backend-action overrides for discrete action ``i`` (0 = no override =
+    no-op) and ``noop`` is the rest-state template every step starts from.
+    """
+    actions_map: Dict[int, Dict[str, Any]] = {0: {}}
+    noop: Dict[str, Any] = {}
+    idx = 1
+    for key in action_space:
+        sub = action_space[key]
+        if key == "camera":
+            noop[key] = np.zeros(2, dtype=np.float32)
+            variants: List[Any] = list(_CAMERA_TURNS)
+        elif _is_enum_space(sub):
+            noop[key] = _NONE
+            vocab = [v for v in list(sub.values) if v != _NONE]
+            variants = vocab
+        else:
+            noop[key] = 0
+            variants = [1]
+        for v in variants:
+            actions_map[idx] = {key: v}
+            if key in ("jump", "sneak", "sprint"):
+                actions_map[idx]["forward"] = 1
+            idx += 1
+    return actions_map, noop
+
+
+class MineRLWrapper(gym.Env):
+    metadata = {"render_modes": ["rgb_array", "human"]}
+
+    def __init__(
+        self,
+        id: str,
+        height: int = 64,
+        width: int = 64,
+        pitch_limits: Tuple[int, int] = (-60, 60),
+        seed: Optional[int] = None,
+        sticky_attack: Optional[int] = 30,
+        sticky_jump: Optional[int] = 10,
+        break_speed_multiplier: Optional[int] = 100,
+        multihot_inventory: bool = True,
+        **kwargs: Any,
+    ):
+        self._height = height
+        self._width = width
+        self._pitch_limits = tuple(pitch_limits)
+        self._sticky_attack = 0 if (break_speed_multiplier or 1) > 1 else (sticky_attack or 0)
+        self._sticky_jump = sticky_jump or 0
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._multihot = multihot_inventory
+        if "navigate" not in id.lower():
+            kwargs.pop("extreme", None)
+
+        self.env = _make_backend(id, break_speed_multiplier, **kwargs)
+        self.actions_map, self._noop = build_action_map(self.env.action_space)
+        self.action_space = spaces.Discrete(len(self.actions_map))
+
+        backend_obs = self.env.observation_space
+        if self._multihot:
+            vocab = _item_vocab()
+        else:
+            vocab = list(backend_obs["inventory"])
+        self.inventory_item_to_id = {name: i for i, name in enumerate(vocab)}
+        self.inventory_size = len(vocab)
+
+        obs_space: Dict[str, spaces.Space] = {
+            "rgb": spaces.Box(0, 255, (height, width, 3), np.uint8),
+            "life_stats": spaces.Box(0.0, np.array([20.0, 20.0, 300.0]), (3,), np.float32),
+            "inventory": spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+            "max_inventory": spaces.Box(0.0, np.inf, (self.inventory_size,), np.float32),
+        }
+        if "compass" in backend_obs.spaces:
+            obs_space["compass"] = spaces.Box(-180.0, 180.0, (1,), np.float32)
+        if "equipped_items" in backend_obs.spaces:
+            if self._multihot:
+                self.equip_item_to_id = self.inventory_item_to_id
+                self.equip_size = self.inventory_size
+            else:
+                equip_vocab = list(backend_obs["equipped_items"]["mainhand"]["type"].values)
+                self.equip_item_to_id = {name: i for i, name in enumerate(equip_vocab)}
+                self.equip_size = len(equip_vocab)
+            obs_space["equipment"] = spaces.Box(0.0, 1.0, (self.equip_size,), np.int32)
+        self.observation_space = spaces.Dict(obs_space)
+
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        self._max_inventory = np.zeros(self.inventory_size, dtype=np.float32)
+        self._render_mode = "rgb_array"
+        self.seed(seed)
+
+    # -- gym plumbing ------------------------------------------------------
+    @property
+    def render_mode(self) -> Optional[str]:
+        return self._render_mode
+
+    def seed(self, seed: Optional[int] = None) -> None:
+        self.observation_space.seed(seed)
+        self.action_space.seed(seed)
+
+    # -- action conversion -------------------------------------------------
+    def _convert_action(self, action: np.ndarray) -> Dict[str, Any]:
+        out = {k: (v.copy() if isinstance(v, np.ndarray) else v) for k, v in self._noop.items()}
+        out.update(self.actions_map[int(np.asarray(action).item())])
+        if self._sticky_attack:
+            if out.get("attack"):
+                self._sticky_attack_counter = self._sticky_attack
+            if self._sticky_attack_counter > 0:
+                out["attack"] = 1
+                out["jump"] = 0  # holding attack releases jump
+                self._sticky_attack_counter -= 1
+        if self._sticky_jump:
+            if out.get("jump"):
+                self._sticky_jump_counter = self._sticky_jump
+            if self._sticky_jump_counter > 0:
+                out["jump"] = 1
+                out["forward"] = 1
+                self._sticky_jump_counter -= 1
+        return out
+
+    # -- observation conversion --------------------------------------------
+    def _convert_inventory(self, inventory: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        counts = np.zeros(self.inventory_size, dtype=np.float32)
+        for item, qty in inventory.items():
+            idx = self.inventory_item_to_id.get(item)
+            if idx is None:  # outside the task's observed item list
+                continue
+            # "air" reports stack counts; count one per occurrence instead
+            counts[idx] += 1.0 if item == "air" else float(np.asarray(qty).item())
+        self._max_inventory = np.maximum(counts, self._max_inventory)
+        return {"inventory": counts, "max_inventory": self._max_inventory.copy()}
+
+    def _convert_equipment(self, equipped: Dict[str, Any]) -> np.ndarray:
+        onehot = np.zeros(self.equip_size, dtype=np.int32)
+        name = equipped["mainhand"]["type"]
+        onehot[self.equip_item_to_id.get(name, self.equip_item_to_id["air"])] = 1
+        return onehot
+
+    def _convert_obs(self, obs: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        out = {
+            "rgb": np.asarray(obs["pov"]).copy(),  # already HWC — TPU-native layout
+            "life_stats": np.array(
+                [obs["life_stats"]["life"], obs["life_stats"]["food"], obs["life_stats"]["air"]],
+                dtype=np.float32,
+            ),
+            **self._convert_inventory(obs["inventory"]),
+        }
+        if "equipment" in self.observation_space.spaces:
+            out["equipment"] = self._convert_equipment(obs["equipped_items"])
+        if "compass" in self.observation_space.spaces:
+            out["compass"] = np.asarray(obs["compass"]["angle"], dtype=np.float32).reshape(1)
+        return out
+
+    # -- env API -----------------------------------------------------------
+    def step(self, action: np.ndarray) -> Tuple[Dict[str, Any], float, bool, bool, Dict[str, Any]]:
+        converted = self._convert_action(action)
+        camera = np.asarray(converted["camera"], dtype=np.float32)
+        next_pitch = self._pos["pitch"] + float(camera[0])
+        next_yaw = ((self._pos["yaw"] + float(camera[1])) + 180.0) % 360.0 - 180.0
+        if not (self._pitch_limits[0] <= next_pitch <= self._pitch_limits[1]):
+            converted["camera"] = np.array([0.0, camera[1]], dtype=np.float32)
+            next_pitch = self._pos["pitch"]
+
+        obs, reward, done, info = self.env.step(converted)
+        self._pos = {"pitch": next_pitch, "yaw": next_yaw}
+        # MineRL cannot distinguish a true terminal from its own time limit;
+        # the framework's TimeLimit wrapper supplies truncations.
+        return self._convert_obs(obs), float(reward), bool(done), False, dict(info)
+
+    def reset(
+        self, *, seed: Optional[int] = None, options: Optional[Dict[str, Any]] = None
+    ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        obs = self.env.reset()
+        self._max_inventory = np.zeros(self.inventory_size, dtype=np.float32)
+        self._sticky_attack_counter = 0
+        self._sticky_jump_counter = 0
+        self._pos = {"pitch": 0.0, "yaw": 0.0}
+        return self._convert_obs(obs), {}
+
+    def render(self) -> Optional[np.ndarray]:
+        return self.env.render(self._render_mode)
+
+    def close(self) -> None:
+        self.env.close()

@@ -1,0 +1,208 @@
+"""Gated compiler from :class:`MineRLTaskSpec` records to minerl EnvSpecs.
+
+Role parity with the reference's imperative spec subclasses (reference:
+sheeprl/envs/minerl_envs/backend.py:19-61): base observables (POV, location,
+life stats), the simple keyboard+camera action set, and the break-speed
+server handler.  The design differs deliberately: task content lives in the
+declarative records of :mod:`sheeprl_tpu.envs.minerl_envs.specs` (testable
+without minerl) and this module compiles a record into a concrete
+``EnvSpec`` subclass when the ``minerl`` package is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List
+
+from sheeprl_tpu.utils.imports import _IS_MINERL_AVAILABLE
+from sheeprl_tpu.envs.minerl_envs.specs import (
+    NONE,
+    OTHER,
+    SIMPLE_KEYBOARD_ACTIONS,
+    MineRLTaskSpec,
+    success_from_rewards,
+)
+
+if not _IS_MINERL_AVAILABLE:
+    raise ModuleNotFoundError(
+        "The MineRL spec builders need the 'minerl' package (plus a JDK); "
+        "it is not available in this image. The task definitions themselves "
+        "live in sheeprl_tpu/envs/minerl_envs/specs.py and do not need it."
+    )
+
+from minerl.herobraine.env_spec import EnvSpec  # type: ignore  # noqa: E402
+from minerl.herobraine.hero import handler, handlers  # type: ignore  # noqa: E402
+from minerl.herobraine.hero.mc import INVERSE_KEYMAP  # type: ignore  # noqa: E402
+
+
+class BreakSpeedMultiplier(handler.Handler):
+    """Server-side block-breaking speed-up (the 'fast mining' used by the
+    Dreamer Minecraft experiments)."""
+
+    def __init__(self, multiplier: float = 1.0):
+        self.multiplier = multiplier
+
+    def to_string(self) -> str:
+        return f"break_speed({self.multiplier})"
+
+    def xml_template(self) -> str:
+        return "<BreakSpeedMultiplier>{{multiplier}}</BreakSpeedMultiplier>"
+
+
+def compile_spec(
+    spec: MineRLTaskSpec,
+    resolution=(64, 64),
+    break_speed: int = 100,
+    **env_spec_kwargs: Any,
+) -> EnvSpec:
+    """Build a concrete minerl ``EnvSpec`` from a declarative task record."""
+
+    class _CompiledSpec(EnvSpec):
+        def __init__(self) -> None:
+            self.resolution = resolution
+            self.break_speed = break_speed
+            # Time limits are enforced by the framework's TimeLimit wrapper
+            # (MineRL cannot distinguish terminated from truncated itself).
+            super().__init__(spec.name, max_episode_steps=None, **env_spec_kwargs)
+
+        # -- agent ---------------------------------------------------------
+        def create_agent_start(self) -> List[handler.Handler]:
+            start: List[handler.Handler] = [BreakSpeedMultiplier(self.break_speed)]
+            if spec.start_inventory:
+                start.append(
+                    handlers.SimpleInventoryAgentStart(
+                        [dict(type=item, quantity=qty) for item, qty in spec.start_inventory]
+                    )
+                )
+            return start
+
+        def create_observables(self) -> List[handler.Handler]:
+            obs = [
+                handlers.POVObservation(self.resolution),
+                handlers.ObservationFromCurrentLocation(),
+                handlers.ObservationFromLifeStats(),
+                handlers.FlatInventoryObservation(list(spec.inventory_items)),
+            ]
+            if spec.compass:
+                obs.append(handlers.CompassObservation(angle=True, distance=False))
+            if spec.equipment_obs_items:
+                obs.append(
+                    handlers.EquippedItemObservation(
+                        items=list(spec.equipment_obs_items), _default="air", _other=OTHER
+                    )
+                )
+            return obs
+
+        def create_actionables(self) -> List[handler.Handler]:
+            acts: List[handler.Handler] = [
+                handlers.KeybasedCommandAction(k, v)
+                for k, v in INVERSE_KEYMAP.items()
+                if k in SIMPLE_KEYBOARD_ACTIONS
+            ] + [handlers.CameraAction()]
+            enum_actions = (
+                (handlers.PlaceBlock, spec.place_items),
+                (handlers.EquipAction, spec.equip_items),
+                (handlers.CraftAction, spec.craft_items),
+                (handlers.CraftNearbyAction, spec.nearby_craft_items),
+                (handlers.SmeltItemNearby, spec.nearby_smelt_items),
+            )
+            for handler_cls, vocab in enum_actions:
+                if vocab:
+                    acts.append(handler_cls(list(vocab), _other=NONE, _default=NONE))
+            return acts
+
+        def create_rewardables(self) -> List[handler.Handler]:
+            rewards: List[handler.Handler] = []
+            if spec.milestones:
+                rewards.append(
+                    handlers.RewardForCollectingItemsOnce(
+                        [dict(type=i, amount=1, reward=r) for i, r in spec.milestones]
+                    )
+                )
+            if spec.touch_block_rewards:
+                rewards.append(
+                    handlers.RewardForTouchingBlockType(
+                        [
+                            {"type": block, "behaviour": "onceOnly", "reward": r}
+                            for block, r in spec.touch_block_rewards
+                        ]
+                    )
+                )
+            if spec.distance_reward_per_block is not None:
+                rewards.append(
+                    handlers.RewardForDistanceTraveledToCompassTarget(
+                        reward_per_block=spec.distance_reward_per_block
+                    )
+                )
+            return rewards
+
+        def create_agent_handlers(self) -> List[handler.Handler]:
+            out: List[handler.Handler] = []
+            if spec.quit_on_touch:
+                out.append(handlers.AgentQuitFromTouchingBlockType(list(spec.quit_on_touch)))
+            if spec.quit_on_possess:
+                out.append(
+                    handlers.AgentQuitFromPossessingItem(
+                        [dict(type=i, amount=a) for i, a in spec.quit_on_possess]
+                    )
+                )
+            if spec.quit_on_craft:
+                out.append(
+                    handlers.AgentQuitFromCraftingItem(
+                        [dict(type=i, amount=a) for i, a in spec.quit_on_craft]
+                    )
+                )
+            return out
+
+        def create_monitors(self) -> List[handler.Handler]:
+            return []
+
+        # -- server --------------------------------------------------------
+        def create_server_world_generators(self) -> List[handler.Handler]:
+            if spec.biome is not None:
+                return [handlers.BiomeGenerator(biome=spec.biome, force_reset=True)]
+            return [handlers.DefaultWorldGenerator(force_reset=True)]
+
+        def create_server_quit_producers(self) -> List[handler.Handler]:
+            return [handlers.ServerQuitWhenAnyAgentFinishes()]
+
+        def create_server_decorators(self) -> List[handler.Handler]:
+            if spec.compass:
+                # navigate target: a diamond block ~64m out with a jittered
+                # compass reading
+                return [
+                    handlers.NavigationDecorator(
+                        max_randomized_radius=64,
+                        min_randomized_radius=64,
+                        block="diamond_block",
+                        placement="surface",
+                        max_radius=8,
+                        min_radius=0,
+                        max_randomized_distance=8,
+                        min_randomized_distance=0,
+                        randomize_compass_location=True,
+                    )
+                ]
+            return []
+
+        def create_server_initial_conditions(self) -> List[handler.Handler]:
+            return [
+                handlers.TimeInitialCondition(
+                    allow_passage_of_time=spec.time_passes, start_time=6000
+                ),
+                *([handlers.WeatherInitialCondition("clear")] if not spec.time_passes else []),
+                handlers.SpawningInitialCondition(
+                    "true" if spec.allow_spawning else "false"
+                ),
+            ]
+
+        # -- bookkeeping ---------------------------------------------------
+        def is_from_folder(self, folder: str) -> bool:
+            return False  # custom tasks have no demonstration dataset
+
+        def get_docstring(self) -> str:
+            return f"Custom task {spec.name} compiled from a declarative spec."
+
+        def determine_success_from_rewards(self, rewards: list) -> bool:
+            return success_from_rewards(spec, list(rewards))
+
+    return _CompiledSpec()

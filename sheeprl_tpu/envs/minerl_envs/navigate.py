@@ -1,0 +1,33 @@
+"""Custom Navigate task (reference: sheeprl/envs/minerl_envs/navigate.py:18-97).
+
+Thin gated entry point: the task content is the declarative
+:func:`sheeprl_tpu.envs.minerl_envs.specs.navigate_spec` record; this module
+compiles it into a minerl ``EnvSpec`` when the backend is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from sheeprl_tpu.envs.minerl_envs.specs import navigate_spec
+
+NAVIGATE_STEPS = 6000
+
+
+class CustomNavigate:
+    """Callable-spec facade matching the reference class's construction API:
+    ``CustomNavigate(dense=..., extreme=..., break_speed=...).make()``."""
+
+    def __init__(self, dense: bool = False, extreme: bool = False, break_speed: int = 100, **kwargs: Any):
+        from sheeprl_tpu.envs.minerl_envs.backend import compile_spec  # gated import
+
+        kwargs.pop("max_episode_steps", None)  # handled by the TimeLimit wrapper
+        self._spec = compile_spec(
+            navigate_spec(dense=dense, extreme=extreme), break_speed=break_speed, **kwargs
+        )
+
+    def make(self) -> Any:
+        return self._spec.make()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._spec, name)

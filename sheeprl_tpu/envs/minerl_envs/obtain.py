@@ -1,0 +1,46 @@
+"""Custom Obtain tasks (reference: sheeprl/envs/minerl_envs/obtain.py:23-326).
+
+Thin gated entry points: the item hierarchies, reward schedules, action
+vocabularies and quit conditions are the declarative records in
+:mod:`sheeprl_tpu.envs.minerl_envs.specs`; this module compiles them into
+minerl ``EnvSpec`` objects when the backend is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+from sheeprl_tpu.envs.minerl_envs.specs import (
+    MineRLTaskSpec,
+    obtain_diamond_spec,
+    obtain_iron_pickaxe_spec,
+)
+
+
+class _CustomObtain:
+    def __init__(self, spec_factory: Callable[[bool], MineRLTaskSpec], dense: bool, break_speed: int, **kwargs: Any):
+        from sheeprl_tpu.envs.minerl_envs.backend import compile_spec  # gated import
+
+        kwargs.pop("max_episode_steps", None)  # handled by the TimeLimit wrapper
+        kwargs.pop("extreme", None)  # navigate-only knob
+        self._spec = compile_spec(spec_factory(dense), break_speed=break_speed, **kwargs)
+
+    def make(self) -> Any:
+        return self._spec.make()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self._spec, name)
+
+
+class CustomObtainDiamond(_CustomObtain):
+    """18000-step (15 min) diamond hunt with the 12-milestone reward chain."""
+
+    def __init__(self, dense: bool = False, break_speed: int = 100, **kwargs: Any):
+        super().__init__(obtain_diamond_spec, dense, break_speed, **kwargs)
+
+
+class CustomObtainIronPickaxe(_CustomObtain):
+    """6000-step (5 min) iron-pickaxe hunt (11 milestones, quits on craft)."""
+
+    def __init__(self, dense: bool = False, break_speed: int = 100, **kwargs: Any):
+        super().__init__(obtain_iron_pickaxe_spec, dense, break_speed, **kwargs)

@@ -200,13 +200,28 @@ class Fabric:
         return NamedSharding(self.mesh, P(self.data_axis))
 
     def shard_batch(self, tree: Any, axis: int = 0) -> Any:
-        """Place a host batch on device, split along ``axis`` over the mesh."""
+        """Place a host batch on device, split along ``axis`` over the mesh.
+
+        Single-process: a plain ``device_put`` onto the mesh-wide sharding.
+        Multi-host (DCN): each process holds its *own* locally-sampled shard,
+        and ``device_put`` onto a non-fully-addressable sharding is not the
+        sanctioned path — assemble the global array from per-process locals
+        via ``multihost_utils.host_local_array_to_global_array`` instead.
+        """
+        multi_host = self.num_processes > 1
+        if multi_host:
+            from jax.experimental import multihost_utils
 
         def put(x: Any) -> Any:
             spec = [None] * np.ndim(x)
             if np.ndim(x) > axis:
                 spec[axis] = self.data_axis
-            return jax.device_put(x, NamedSharding(self.mesh, P(*spec)))
+            pspec = P(*spec)
+            if multi_host:
+                return multihost_utils.host_local_array_to_global_array(
+                    np.asarray(x), self.mesh, pspec
+                )
+            return jax.device_put(x, NamedSharding(self.mesh, pspec))
 
         return jax.tree.map(put, tree)
 

@@ -77,18 +77,22 @@ class _consistent_tail:
     def __enter__(self) -> "_consistent_tail":
         for rb in self.rbs:
             patch = {}
-            if len(rb) == 0:
+            # Buffers that store an explicit next_obs per row (SAC/DroQ style)
+            # need no tail patch: every row is self-contained, and forcing a
+            # fake terminated=1 would permanently drop that row's bootstrap
+            # after a buffer-checkpointed resume.
+            if len(rb) == 0 or any(k.startswith("next_") for k in rb.keys()):
                 self._saved.append(patch)
                 continue
             tail = (rb._pos - 1) % rb.buffer_size
-            for key in ("truncated", "dones", "terminated"):
+            # Only episode-boundary keys that mean "do not continue across the
+            # checkpoint" are patched: truncated/dones. Never force
+            # terminated=1 — that is a value-semantics (bootstrap-killing)
+            # flag, not a storage-boundary one.
+            for key in ("truncated", "dones"):
                 if key in rb:
                     patch[key] = (tail, np.array(rb._buf[key][tail]))
-                    rb._buf[key][tail] = (
-                        np.ones_like(np.asarray(rb._buf[key][tail]))
-                        if key == "truncated" or "truncated" not in rb
-                        else rb._buf[key][tail]
-                    )
+                    rb._buf[key][tail] = np.ones_like(np.asarray(rb._buf[key][tail]))
             self._saved.append(patch)
         return self
 

@@ -42,14 +42,23 @@ def get_dummy_env(env_id: str) -> gym.Env:
     return DUMMY_ENVS[env_id]()
 
 
-def _make_base_env(cfg: Any, seed: Optional[int], render_mode: str) -> gym.Env:
-    env_id = cfg.env.id
-    if env_id in DUMMY_ENVS:
-        return get_dummy_env(env_id)
+def _wrapper_config(cfg: Any) -> Dict[str, Any]:
+    """Normalize ``cfg.env.wrapper`` (dict, bare suite name, or the "???"
+    placeholder) into a dict with a ``kind`` entry."""
     wrapper_cfg = cfg.env.get("wrapper") or {}
     if not isinstance(wrapper_cfg, dict):  # "???" placeholder or suite name
         wrapper_cfg = {"kind": str(wrapper_cfg)} if wrapper_cfg != "???" else {}
-    kind = wrapper_cfg.get("kind", "gym")
+    return {"kind": "gym", **wrapper_cfg}
+
+
+def _make_base_env(
+    cfg: Any, seed: Optional[int], render_mode: str, rank: int = 0, vector_env_idx: int = 0
+) -> gym.Env:
+    env_id = cfg.env.id
+    if env_id in DUMMY_ENVS:
+        return get_dummy_env(env_id)
+    wrapper_cfg = _wrapper_config(cfg)
+    kind = wrapper_cfg["kind"]
     if kind == "gym":
         kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
         return gym.make(env_id, render_mode=render_mode, **kwargs)
@@ -67,6 +76,29 @@ def _make_base_env(cfg: Any, seed: Optional[int], render_mode: str) -> gym.Env:
 
         kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
         return CrafterWrapper(env_id, **kwargs)
+    if kind == "minedojo":
+        from sheeprl_tpu.envs.minedojo import MineDojoWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return MineDojoWrapper(env_id, seed=seed, **kwargs)
+    if kind == "minerl":
+        from sheeprl_tpu.envs.minerl import MineRLWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return MineRLWrapper(env_id, seed=seed, **kwargs)
+    if kind == "diambra":
+        from sheeprl_tpu.envs.diambra import DiambraWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        # each parallel env needs its own engine slot (reference:
+        # sheeprl/utils/env.py:72 uses rank * num_envs + vector_env_idx)
+        kwargs.setdefault("rank", rank * int(cfg.env.num_envs) + vector_env_idx)
+        return DiambraWrapper(env_id, render_mode=render_mode, **kwargs)
+    if kind == "super_mario_bros":
+        from sheeprl_tpu.envs.super_mario_bros import SuperMarioBrosWrapper
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return SuperMarioBrosWrapper(env_id, render_mode=render_mode, **kwargs)
     raise ValueError(f"Unknown env wrapper kind '{kind}'")
 
 
@@ -155,9 +187,13 @@ def make_env(
     def _build() -> gym.Env:
         capture = bool(cfg.env.capture_video) and rank == 0 and vector_env_idx == 0 and run_name is not None
         render_mode = "rgb_array" if capture else cfg.env.get("render_mode", "rgb_array")
-        env = _make_base_env(cfg, seed, render_mode)
+        env = _make_base_env(cfg, seed, render_mode, rank=rank, vector_env_idx=vector_env_idx)
 
-        if cfg.env.action_repeat > 1:
+        # Suites that repeat actions inside their own engine (atari via
+        # frame_skip, DIAMBRA via WrappersSettings.repeat_action) must not be
+        # wrapped again or frames/rewards would be consumed twice
+        # (reference: sheeprl/utils/env.py:76-81 excludes both).
+        if cfg.env.action_repeat > 1 and _wrapper_config(cfg)["kind"] not in ("atari", "diambra"):
             env = ActionRepeat(env, cfg.env.action_repeat)
         if cfg.env.get("mask_velocities", False):
             env = MaskVelocityWrapper(env)

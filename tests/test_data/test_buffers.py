@@ -173,6 +173,19 @@ class TestEpisodeBuffer:
         with pytest.raises(RuntimeError):
             eb.sample(1)
 
+    def test_truncated_commits_without_terminated_key(self):
+        # 'dones' + 'truncated' data (no 'terminated'): a truncation alone
+        # must close the episode (reference: data/buffers.py EpisodeBuffer.add
+        # ORs truncated into the end signal unconditionally).
+        eb = EpisodeBuffer(100, sequence_length=2, n_envs=1)
+        data = self.make_episode_data(6)
+        data["dones"][-1] = 0.0
+        data["truncated"] = np.zeros_like(data["dones"])
+        data["truncated"][-1] = 1.0
+        eb.add(data)
+        assert len(eb.buffer) == 1
+        assert len(eb) == 6
+
 
 class TestReviewRegressions:
     def test_sequential_sample_next_obs(self):

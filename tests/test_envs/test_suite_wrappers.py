@@ -1,0 +1,515 @@
+"""Suite wrappers (MineRL / MineDojo / DIAMBRA / Super Mario Bros) against
+mock backends.
+
+The real backends (Java Minecraft, the DIAMBRA docker engine, nes-py) are
+not installable in this image; these tests drive the full conversion logic
+— action maps, sticky actions, inventory/mask vectorization, termination
+semantics — through fake simulators wired in via each module's
+``_make_backend`` / ``_item_vocab`` seams.
+"""
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pytest
+from gymnasium import spaces
+
+import sheeprl_tpu.envs.minedojo as minedojo_mod
+import sheeprl_tpu.envs.minerl as minerl_mod
+import sheeprl_tpu.envs.super_mario_bros as smb_mod
+import sheeprl_tpu.envs.diambra as diambra_mod
+from sheeprl_tpu.envs.minerl_envs import specs as minerl_specs
+
+
+# =========================================================================
+# Super Mario Bros
+# =========================================================================
+class _FakeNES:
+    """Old-gym NES backend: reset()->obs, step()->(obs, r, done, info)."""
+
+    def __init__(self):
+        self.observation_space = spaces.Box(0, 255, (240, 256, 3), np.uint8)
+        self.action_space = spaces.Discrete(7)
+        self.next = (0.0, False, {"time": 300})
+
+    def reset(self, seed=None, options=None):
+        return np.zeros((240, 256, 3), np.uint8)
+
+    def step(self, action):
+        assert isinstance(action, int)
+        r, done, info = self.next
+        return np.full((240, 256, 3), 7, np.uint8), r, done, info
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def smb(monkeypatch):
+    fake = _FakeNES()
+    monkeypatch.setattr(smb_mod, "_make_backend", lambda env_id, action_set: fake)
+    return smb_mod.SuperMarioBrosWrapper("SuperMarioBros-v0", action_space="simple"), fake
+
+
+def test_smb_spaces_and_reset(smb):
+    env, _ = smb
+    obs, info = env.reset()
+    assert set(env.observation_space.spaces) == {"rgb"}
+    assert obs["rgb"].shape == (240, 256, 3)
+    assert env.action_space == spaces.Discrete(7)
+
+
+def test_smb_death_is_terminated(smb):
+    env, fake = smb
+    env.reset()
+    fake.next = (-15.0, True, {"time": 250})  # died with time on the clock
+    _, r, terminated, truncated, _ = env.step(np.array([3]))
+    assert terminated and not truncated and r == -15.0
+
+
+def test_smb_timeout_is_truncated(smb):
+    env, fake = smb
+    env.reset()
+    fake.next = (0.0, True, {"time": 0})  # timer expired
+    _, _, terminated, truncated, _ = env.step(2)
+    assert truncated and not terminated
+
+
+def test_smb_new_api_backend(monkeypatch):
+    fake = _FakeNES()
+
+    def step5(action):
+        return np.zeros((240, 256, 3), np.uint8), 1.0, False, True, {"time": 100}
+
+    fake.step = step5
+    monkeypatch.setattr(smb_mod, "_make_backend", lambda env_id, action_set: fake)
+    env = smb_mod.SuperMarioBrosWrapper("SuperMarioBros-v0")
+    env.reset()
+    _, r, terminated, truncated, _ = env.step(0)
+    assert r == 1.0 and truncated and not terminated
+
+
+def test_smb_rejects_unknown_action_set(monkeypatch):
+    with pytest.raises(ValueError):
+        smb_mod.SuperMarioBrosWrapper("SuperMarioBros-v0", action_space="bogus")
+
+
+# =========================================================================
+# DIAMBRA
+# =========================================================================
+class _FakeArena:
+    def __init__(self):
+        self.observation_space = spaces.Dict(
+            {
+                "frame": spaces.Box(0, 255, (64, 64, 3), np.uint8),
+                "stage": spaces.Discrete(5),
+                "moves": spaces.MultiDiscrete([9, 4]),
+            }
+        )
+        self.action_space = spaces.Discrete(10)
+        self.last_action: Any = None
+        self.info: Dict[str, Any] = {}
+
+    def reset(self, seed=None, options=None):
+        return self._obs(), {}
+
+    def step(self, action):
+        self.last_action = action
+        return self._obs(), 1.5, False, False, dict(self.info)
+
+    def _obs(self):
+        return {
+            "frame": np.zeros((64, 64, 3), np.uint8),
+            "stage": 2,
+            "moves": np.array([3, 1]),
+        }
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def diambra(monkeypatch):
+    fake = _FakeArena()
+    monkeypatch.setattr(diambra_mod, "_make_backend", lambda *a, **k: fake)
+    return diambra_mod.DiambraWrapper("doapp"), fake
+
+
+def test_diambra_space_flattening(diambra):
+    env, _ = diambra
+    assert isinstance(env.observation_space["stage"], spaces.Box)
+    assert env.observation_space["stage"].shape == (1,)
+    assert env.observation_space["moves"].shape == (2,)
+    obs, info = env.reset()
+    assert obs["stage"].shape == (1,) and obs["stage"][0] == 2
+    assert obs["moves"].shape == (2,)
+    assert info["env_domain"] == "DIAMBRA"
+
+
+def test_diambra_env_done_terminates(diambra):
+    env, fake = diambra
+    env.reset()
+    fake.info = {"env_done": True}
+    _, _, terminated, _, info = env.step(np.array([4]))
+    assert terminated
+    assert fake.last_action == 4  # squeezed to a python int for DISCRETE
+
+
+def test_diambra_validates_args():
+    with pytest.raises(ValueError):
+        diambra_mod.DiambraWrapper("doapp", action_space="BOGUS")
+    with pytest.raises(ValueError):
+        diambra_mod.DiambraWrapper("doapp", diambra_settings={"role": "P3"})
+
+
+def test_diambra_managed_settings_warn(monkeypatch):
+    fake = _FakeArena()
+    monkeypatch.setattr(diambra_mod, "_make_backend", lambda *a, **k: fake)
+    with pytest.warns(UserWarning):
+        diambra_mod.DiambraWrapper("doapp", diambra_settings={"n_players": 2})
+
+
+# =========================================================================
+# MineDojo
+# =========================================================================
+_VOCAB = ["air", "log", "planks", "stone", "wooden_pickaxe"]
+_CRAFT = ["planks", "stick", "crafting_table"]
+
+
+class _FakeMineDojo:
+    def __init__(self):
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(0, 255, (64, 64, 3), np.uint8)}
+        )
+        self.actions: List[np.ndarray] = []
+        self.done = False
+        self.info: Dict[str, Any] = {}
+        self.unwrapped = self
+        self._prev_obs = None
+
+    def make_obs(self, *, inv_names=("air", "log"), inv_qty=(1, 3), pitch=0.0):
+        n_slots = len(inv_names)
+        return {
+            "rgb": np.zeros((64, 64, 3), np.uint8),
+            "inventory": {
+                "name": np.array(inv_names, dtype=object),
+                "quantity": np.asarray(inv_qty, dtype=np.float32),
+            },
+            "delta_inv": {
+                "inc_name_by_craft": ["planks"],
+                "inc_quantity_by_craft": [4],
+                "dec_name_by_craft": ["log"],
+                "dec_quantity_by_craft": [1],
+                "inc_name_by_other": [],
+                "inc_quantity_by_other": [],
+                "dec_name_by_other": [],
+                "dec_quantity_by_other": [],
+            },
+            "equipment": {"name": ["wooden pickaxe"]},
+            "life_stats": {
+                "life": np.array([20.0]),
+                "food": np.array([20.0]),
+                "oxygen": np.array([300.0]),
+            },
+            "masks": {
+                "action_type": np.ones(8, dtype=bool),
+                "equip": np.array([False] * n_slots),
+                "destroy": np.array([True] * n_slots),
+                "craft_smelt": np.array([True, False, True]),
+            },
+            "location_stats": {
+                "pos": np.array([0.0, 64.0, 0.0]),
+                "pitch": np.array([pitch]),
+                "yaw": np.array([0.0]),
+                "biome_id": np.array([1]),
+            },
+        }
+
+    def reset(self):
+        return self.make_obs()
+
+    def step(self, action):
+        self.actions.append(np.asarray(action).copy())
+        return self.make_obs(), 1.0, self.done, dict(self.info)
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def minedojo(monkeypatch):
+    fake = _FakeMineDojo()
+    monkeypatch.setattr(minedojo_mod, "_item_vocab", lambda: (_VOCAB, _CRAFT))
+    monkeypatch.setattr(minedojo_mod, "_make_backend", lambda *a, **k: fake)
+    env = minedojo_mod.MineDojoWrapper("open-ended", sticky_attack=0, sticky_jump=0,
+                                       break_speed_multiplier=100)
+    return env, fake
+
+
+def test_minedojo_spaces(minedojo):
+    env, _ = minedojo
+    n = len(_VOCAB)
+    assert list(env.action_space.nvec) == [19, len(_CRAFT), n]
+    assert env.observation_space["inventory"].shape == (n,)
+    assert env.observation_space["mask_action_type"].shape == (19,)
+    assert env.observation_space["mask_craft_smelt"].shape == (len(_CRAFT),)
+
+
+def test_minedojo_inventory_and_masks(minedojo):
+    env, _ = minedojo
+    obs, info = env.reset()
+    # slot air counts 1 per slot, log counts quantity
+    assert obs["inventory"][_VOCAB.index("air")] == 1.0
+    assert obs["inventory"][_VOCAB.index("log")] == 3.0
+    assert obs["inventory_delta"][_VOCAB.index("planks")] == 4.0
+    assert obs["inventory_delta"][_VOCAB.index("log")] == -1.0
+    assert obs["equipment"][_VOCAB.index("wooden_pickaxe")] == 1
+    # nothing equippable -> equip/place compound actions masked off
+    assert not obs["mask_equip_place"].any()
+    mask = obs["mask_action_type"]
+    assert mask[:12].all()
+    equip_idx = 12 + minedojo_mod.FN_EQUIP - 1
+    place_idx = 12 + minedojo_mod.FN_PLACE - 1
+    destroy_idx = 12 + minedojo_mod.FN_DESTROY - 1
+    assert not mask[equip_idx] and not mask[place_idx]
+    assert mask[destroy_idx]  # destroyables exist
+    assert obs["life_stats"].tolist() == [20.0, 20.0, 300.0]
+
+
+def test_minedojo_action_conversion(minedojo):
+    env, fake = minedojo
+    env.reset()
+    # forward
+    env.step(np.array([1, 0, 0]))
+    assert fake.actions[-1][minedojo_mod.SLOT_MOVE] == 1
+    # craft passes the craft argument through
+    craft_action = 12 + minedojo_mod.FN_CRAFT - 1
+    env.step(np.array([craft_action, 2, 0]))
+    assert fake.actions[-1][minedojo_mod.SLOT_FN] == minedojo_mod.FN_CRAFT
+    assert fake.actions[-1][minedojo_mod.SLOT_CRAFT_ARG] == 2
+    # destroy resolves the item id to its inventory slot (log is slot 1)
+    destroy_action = 12 + minedojo_mod.FN_DESTROY - 1
+    env.step(np.array([destroy_action, 0, _VOCAB.index("log")]))
+    assert fake.actions[-1][minedojo_mod.SLOT_INV_ARG] == 1
+
+
+def test_minedojo_pitch_clamp(monkeypatch):
+    fake = _FakeMineDojo()
+    monkeypatch.setattr(minedojo_mod, "_item_vocab", lambda: (_VOCAB, _CRAFT))
+    monkeypatch.setattr(minedojo_mod, "_make_backend", lambda *a, **k: fake)
+    env = minedojo_mod.MineDojoWrapper("open-ended", pitch_limits=(-60, 60))
+    env.reset()
+    fake.make_obs = lambda **kw: _FakeMineDojo.make_obs(fake, pitch=60.0)
+    env.step(np.array([9, 0, 0]))  # pitch up from 0: fine
+    env.step(np.array([9, 0, 0]))  # pitch up from 60: must be clamped
+    assert fake.actions[-1][minedojo_mod.SLOT_PITCH] == minedojo_mod.CAMERA_NOOP
+
+
+def test_minedojo_sticky_attack(monkeypatch):
+    fake = _FakeMineDojo()
+    monkeypatch.setattr(minedojo_mod, "_item_vocab", lambda: (_VOCAB, _CRAFT))
+    monkeypatch.setattr(minedojo_mod, "_make_backend", lambda *a, **k: fake)
+    env = minedojo_mod.MineDojoWrapper(
+        "open-ended", sticky_attack=3, sticky_jump=0, break_speed_multiplier=1
+    )
+    env.reset()
+    attack = 12 + minedojo_mod.FN_ATTACK - 1
+    env.step(np.array([attack, 0, 0]))
+    env.step(np.array([0, 0, 0]))  # no-op -> attack repeats
+    assert fake.actions[-1][minedojo_mod.SLOT_FN] == minedojo_mod.FN_ATTACK
+    craft = 12 + minedojo_mod.FN_CRAFT - 1
+    env.step(np.array([craft, 0, 0]))  # other functional action interrupts
+    env.step(np.array([0, 0, 0]))
+    assert fake.actions[-1][minedojo_mod.SLOT_FN] == minedojo_mod.FN_NOOP
+
+
+def test_minedojo_sticky_jump(monkeypatch):
+    fake = _FakeMineDojo()
+    monkeypatch.setattr(minedojo_mod, "_item_vocab", lambda: (_VOCAB, _CRAFT))
+    monkeypatch.setattr(minedojo_mod, "_make_backend", lambda *a, **k: fake)
+    env = minedojo_mod.MineDojoWrapper("open-ended", sticky_attack=0, sticky_jump=5)
+    env.reset()
+    env.step(np.array([5, 0, 0]))  # jump+forward
+    env.step(np.array([0, 0, 0]))  # no-op: jump held, forced forward
+    assert fake.actions[-1][minedojo_mod.SLOT_JUMP] == 1
+    assert fake.actions[-1][minedojo_mod.SLOT_MOVE] == 1
+
+
+# =========================================================================
+# MineRL
+# =========================================================================
+class _EnumSpace(spaces.Space):
+    def __init__(self, values):
+        super().__init__((), np.dtype(object))
+        self.values = np.array(values, dtype=object)
+
+    def sample(self, mask=None):
+        return self.values[0]
+
+    def contains(self, x):
+        return x in self.values
+
+
+def _fake_minerl_backend(with_compass=True, with_equipment=False):
+    class _Backend:
+        def __init__(self):
+            self.action_space = spaces.Dict(
+                {
+                    "forward": spaces.Discrete(2),
+                    "jump": spaces.Discrete(2),
+                    "attack": spaces.Discrete(2),
+                    "camera": spaces.Box(-180.0, 180.0, (2,), np.float32),
+                    "place": _EnumSpace(["none", "dirt"]),
+                }
+            )
+            obs = {
+                "pov": spaces.Box(0, 255, (64, 64, 3), np.uint8),
+                "inventory": spaces.Dict({"dirt": spaces.Box(0, 2304, (), np.float32)}),
+            }
+            if with_compass:
+                obs["compass"] = spaces.Dict(
+                    {"angle": spaces.Box(-180.0, 180.0, (), np.float32)}
+                )
+            if with_equipment:
+                obs["equipped_items"] = spaces.Dict(
+                    {"mainhand": spaces.Dict({"type": _EnumSpace(["air", "iron_pickaxe"])})}
+                )
+            self.observation_space = spaces.Dict(obs)
+            self.actions: List[Dict[str, Any]] = []
+            self.with_equipment = with_equipment
+
+        def make_obs(self):
+            out = {
+                "pov": np.zeros((64, 64, 3), np.uint8),
+                "life_stats": {"life": 20.0, "food": 20.0, "air": 300.0},
+                "inventory": {"dirt": np.float32(5.0), "air": np.float32(64.0)},
+            }
+            if with_compass:
+                out["compass"] = {"angle": np.float32(42.0)}
+            if self.with_equipment:
+                out["equipped_items"] = {"mainhand": {"type": "unknown_item"}}
+            return out
+
+        def reset(self):
+            return self.make_obs()
+
+        def step(self, action):
+            self.actions.append(action)
+            return self.make_obs(), 0.5, False, {}
+
+        def close(self):
+            pass
+
+    return _Backend()
+
+
+@pytest.fixture
+def minerl(monkeypatch):
+    fake = _fake_minerl_backend()
+    monkeypatch.setattr(minerl_mod, "_make_backend", lambda *a, **k: fake)
+    monkeypatch.setattr(minerl_mod, "_item_vocab", lambda: ["air", "dirt", "stone"])
+    env = minerl_mod.MineRLWrapper(
+        "custom_navigate", sticky_attack=0, sticky_jump=0,
+        break_speed_multiplier=100, multihot_inventory=True,
+    )
+    return env, fake
+
+
+def test_minerl_action_map_enumeration(minerl):
+    env, _ = minerl
+    # 1 noop + forward + jump + attack + 4 camera turns + 1 place value
+    assert env.action_space.n == 9
+    amap = env.actions_map
+    assert amap[0] == {}
+    # jump also presses forward
+    jump_actions = [a for a in amap.values() if a.get("jump") == 1]
+    assert jump_actions and all(a.get("forward") == 1 for a in jump_actions)
+    place_actions = [a for a in amap.values() if "place" in a]
+    assert place_actions == [{"place": "dirt"}]
+
+
+def test_minerl_obs_conversion(minerl):
+    env, _ = minerl
+    obs, _ = env.reset()
+    assert obs["rgb"].shape == (64, 64, 3)  # channel-last, no transpose
+    assert obs["life_stats"].tolist() == [20.0, 20.0, 300.0]
+    assert obs["inventory"][1] == 5.0  # dirt
+    assert obs["inventory"][0] == 1.0  # air counted once
+    assert obs["compass"].shape == (1,) and obs["compass"][0] == 42.0
+
+
+def test_minerl_max_inventory_tracks(minerl):
+    env, fake = minerl
+    env.reset()
+    obs, *_ = env.step(np.array(0))
+    assert obs["max_inventory"][1] == 5.0
+
+
+def test_minerl_pitch_clamp_and_yaw_wrap(minerl):
+    env, fake = minerl
+    env.reset()
+    # camera actions: find pitch-down (negative pitch delta)
+    pitch_down = next(
+        i for i, a in env.actions_map.items()
+        if "camera" in a and np.asarray(a["camera"])[0] < 0
+    )
+    for _ in range(4):  # 4 * -15° = -60° : at the limit
+        env.step(np.array(pitch_down))
+    env.step(np.array(pitch_down))  # would pass -60 -> camera zeroed
+    assert np.asarray(fake.actions[-1]["camera"])[0] == 0.0
+    yaw_left = next(
+        i for i, a in env.actions_map.items()
+        if "camera" in a and np.asarray(a["camera"])[1] < 0
+    )
+    for _ in range(13):  # 13 * -15 = -195 -> wraps to +165
+        env.step(np.array(yaw_left))
+    assert env._pos["yaw"] == pytest.approx(165.0)
+
+
+def test_minerl_sticky_attack_releases_jump(monkeypatch):
+    fake = _fake_minerl_backend()
+    monkeypatch.setattr(minerl_mod, "_make_backend", lambda *a, **k: fake)
+    monkeypatch.setattr(minerl_mod, "_item_vocab", lambda: ["air", "dirt"])
+    env = minerl_mod.MineRLWrapper(
+        "custom_navigate", sticky_attack=3, sticky_jump=2, break_speed_multiplier=1
+    )
+    env.reset()
+    attack = next(i for i, a in env.actions_map.items() if a.get("attack") == 1)
+    jump = next(i for i, a in env.actions_map.items() if a.get("jump") == 1)
+    env.step(np.array(attack))
+    sent = fake.actions[-1]
+    assert sent["attack"] == 1
+    env.step(np.array(jump))  # sticky attack still holds: jump suppressed
+    sent = fake.actions[-1]
+    assert sent["attack"] == 1 and sent["jump"] == 0
+    env.reset()
+    assert env._sticky_attack_counter == 0
+
+
+def test_minerl_task_local_inventory(monkeypatch):
+    fake = _fake_minerl_backend(with_equipment=True)
+    monkeypatch.setattr(minerl_mod, "_make_backend", lambda *a, **k: fake)
+    env = minerl_mod.MineRLWrapper(
+        "custom_obtain_diamond", multihot_inventory=False, sticky_attack=0, sticky_jump=0,
+    )
+    # task-local inventory: only the backend's own item list
+    assert env.inventory_size == 1
+    obs, _ = env.reset()
+    # unknown equipped item falls back to "air"
+    assert obs["equipment"][0] == 1
+
+
+def test_minerl_specs_data():
+    nav = minerl_specs.navigate_spec(dense=True, extreme=False)
+    assert nav.compass and nav.start_inventory == (("compass", 1),)
+    assert minerl_specs.success_from_rewards(nav, [100.0, 60.0])
+    assert not minerl_specs.success_from_rewards(nav, [100.0])
+    dia = minerl_specs.obtain_diamond_spec(dense=False)
+    assert dia.milestones[-1] == ("diamond", 1024.0)
+    assert len(dia.milestones) == 12
+    # success tolerates 10% missing distinct milestone values (1 of 10)
+    rewards = sorted({r for _, r in dia.milestones})[:-1]
+    assert minerl_specs.success_from_rewards(dia, rewards)
+    assert not minerl_specs.success_from_rewards(dia, rewards[:-1])
+    pick = minerl_specs.obtain_iron_pickaxe_spec(dense=False)
+    assert pick.quit_on_craft == (("iron_pickaxe", 1),)

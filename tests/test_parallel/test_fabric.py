@@ -55,6 +55,17 @@ def test_to_host_never_aliases():
     assert host_copy.unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
 
 
+def test_shard_batch_multihost_path(monkeypatch):
+    # Force the process_count()>1 branch: host_local_array_to_global_array is
+    # the sanctioned multi-host assembly path and must produce the same
+    # mesh-sharded result as device_put does single-process.
+    fab = Fabric(devices=4, accelerator="cpu")
+    monkeypatch.setattr(Fabric, "num_processes", property(lambda self: 2))
+    x = fab.shard_batch(np.arange(32, dtype=np.float32).reshape(8, 4))
+    assert "data" in str(x.sharding.spec)
+    np.testing.assert_array_equal(np.asarray(x).reshape(8, 4)[:, 0], np.arange(0, 32, 4))
+
+
 def test_host_collectives_single_process():
     fab = Fabric(devices=2, accelerator="cpu")
     assert fab.broadcast_object({"a": 1}) == {"a": 1}

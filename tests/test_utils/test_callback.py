@@ -1,0 +1,56 @@
+"""Checkpoint-callback buffer-consistency trick.
+
+The tail patch must only touch storage-boundary keys (truncated/dones) and
+must skip buffers that store an explicit next_obs per row — forcing a fake
+``terminated=1`` would permanently kill that transition's bootstrap after a
+buffer-checkpointed resume (reference: sheeprl/utils/callback.py:87-142
+patches only 'truncated').
+"""
+
+import numpy as np
+
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.callback import _consistent_tail
+
+
+def _filled_buffer(keys, steps=4, n_envs=1):
+    rb = ReplayBuffer(buffer_size=8, n_envs=n_envs)
+    data = {k: np.zeros((steps, n_envs, 1), dtype=np.float32) for k in keys}
+    if "obs" not in data:
+        data["obs"] = np.arange(steps * n_envs, dtype=np.float32).reshape(steps, n_envs, 1)
+    rb.add(data)
+    return rb
+
+
+def test_tail_patch_sets_truncated_and_dones_only():
+    rb = _filled_buffer(["obs", "terminated", "truncated", "dones"])
+    tail = (rb._pos - 1) % rb.buffer_size
+    with _consistent_tail(rb):
+        assert rb["truncated"][tail].item() == 1.0
+        assert rb["dones"][tail].item() == 1.0
+        assert rb["terminated"][tail].item() == 0.0  # never forced
+    # restored afterwards
+    assert rb["truncated"][tail].item() == 0.0
+    assert rb["dones"][tail].item() == 0.0
+
+
+def test_tail_patch_never_forces_terminated_when_only_terminated():
+    rb = _filled_buffer(["obs", "terminated"])
+    tail = (rb._pos - 1) % rb.buffer_size
+    with _consistent_tail(rb):
+        assert rb["terminated"][tail].item() == 0.0
+
+
+def test_tail_patch_skipped_for_next_obs_buffers():
+    rb = _filled_buffer(["obs", "next_obs", "terminated", "truncated"])
+    tail = (rb._pos - 1) % rb.buffer_size
+    with _consistent_tail(rb):
+        # rows are self-contained: nothing is patched at all
+        assert rb["truncated"][tail].item() == 0.0
+        assert rb["terminated"][tail].item() == 0.0
+
+
+def test_tail_patch_empty_buffer_noop():
+    rb = ReplayBuffer(buffer_size=8, n_envs=1)
+    with _consistent_tail(rb):
+        pass
