@@ -201,7 +201,7 @@ def main(fabric: Any, cfg: Any) -> None:
             rollout["actions"] = jnp.asarray(local["actions"])
             rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
             rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            if num_envs % fabric.world_size == 0:
+            if num_envs % fabric.local_world_size == 0:
                 rollout = fabric.shard_batch(rollout, axis=1)
             else:
                 rollout = fabric.replicate(rollout)

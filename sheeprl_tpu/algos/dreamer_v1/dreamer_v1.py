@@ -26,10 +26,14 @@ from sheeprl_tpu.utils.registry import register_algorithm
 
 def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
                      cnn_keys, mlp_keys, is_continuous, p2e=None):
-    # ``p2e``: optional Plan2Explore hook {ens_module, ens_opt, w_intrinsic,
-    # w_extrinsic, n, multiplier} — mixes ensemble-disagreement intrinsic
-    # reward into the imagined returns and trains the ensembles
-    # (reference: sheeprl/algos/p2e_dv1 / p2e_dv2 exploration scripts).
+    # ``p2e``: optional Plan2Explore hook {ens_module, ens_opt, n, multiplier}
+    # — trains the forward-model ensembles alongside the world model and runs
+    # TWO behavior updates per step: the exploration actor + its own critic on
+    # the pure ensemble-disagreement intrinsic reward, and the task actor +
+    # task critic on extrinsic rewards (reference:
+    # sheeprl/algos/p2e_dv1/p2e_dv1_exploration.py:207-330 trains
+    # actor_exploration/critic_exploration on intrinsic and
+    # actor_task/critic_task on extrinsic — not a mixed reward).
     obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
     stoch = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
@@ -103,7 +107,8 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         }
         return total, aux
 
-    def behavior_update(p, o_state, latents, terminated, k):
+    def behavior_update(p, o_state, latents, terminated, k,
+                        actor_key="actor", critic_key="critic", reward_kind="extrinsic"):
         L, B = terminated.shape
         n = L * B
         start_latents = jax.lax.stop_gradient(latents.reshape(n, -1))
@@ -123,19 +128,23 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
                 img_step, (start_latents[:, stoch:], start_latents[:, :stoch]), keys
             )
             flat_traj = traj.reshape((horizon + 1) * n, -1)
-            rewards = world_model.apply(p["world_model"], flat_traj, method=WM.reward_logits).reshape(
-                horizon + 1, n
-            )
-            if p2e is not None:
+            if reward_kind == "intrinsic":
+                # ensemble disagreement over next-state predictions
                 preds = p2e["ens_module"].apply(
                     p["ensembles"],
                     jax.lax.stop_gradient(
                         jnp.concatenate([traj, actions_seq], -1)
                     ).reshape((horizon + 1) * n, -1),
                 )
-                intrinsic = preds.reshape(p2e["n"], horizon + 1, n, -1).var(0).mean(-1)
-                rewards = p2e["w_extrinsic"] * rewards + p2e["w_intrinsic"] * intrinsic * p2e["multiplier"]
-            values = critic.apply(p["critic"], flat_traj).reshape(horizon + 1, n)
+                rewards = (
+                    preds.reshape(p2e["n"], horizon + 1, n, -1).var(0).mean(-1)
+                    * p2e["multiplier"]
+                )
+            else:
+                rewards = world_model.apply(
+                    p["world_model"], flat_traj, method=WM.reward_logits
+                ).reshape(horizon + 1, n)
+            values = critic.apply(p[critic_key], flat_traj).reshape(horizon + 1, n)
             if use_continues:
                 continues = (
                     Bernoulli(
@@ -159,9 +168,9 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
 
         (pl, (traj, lambda_values, discount)), a_grads = jax.value_and_grad(
             actor_loss_fn, has_aux=True
-        )(p["actor"])
-        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
-        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+        )(p[actor_key])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state[actor_key], p[actor_key])
+        p = {**p, actor_key: optax.apply_updates(p[actor_key], a_updates)}
 
         traj_sg = jax.lax.stop_gradient(traj[:-1])
         flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
@@ -170,15 +179,15 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
             qv = Normal(critic.apply(critic_params, flat_sg).reshape(horizon, -1), 1.0)
             return -jnp.mean(qv.log_prob(jax.lax.stop_gradient(lambda_values)) * discount[:-1])
 
-        vl, c_grads = jax.value_and_grad(critic_loss_fn)(p["critic"])
-        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
-        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
-        return p, {**o_state, "actor": new_a_opt, "critic": new_c_opt}, pl, vl
+        vl, c_grads = jax.value_and_grad(critic_loss_fn)(p[critic_key])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state[critic_key], p[critic_key])
+        p = {**p, critic_key: optax.apply_updates(p[critic_key], c_updates)}
+        return p, {**o_state, actor_key: new_a_opt, critic_key: new_c_opt}, pl, vl
 
     def single_update(carry, inputs):
         p, o_state, counter = carry
         data, k = inputs
-        k_wm, k_beh = jax.random.split(k)
+        k_wm, k_beh, k_task = jax.random.split(k, 3)
         (wm_l, aux), wm_grads = jax.value_and_grad(wm_forward, has_aux=True)(
             p["world_model"], data, k_wm
         )
@@ -203,7 +212,22 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
             e_updates, new_e_opt = p2e["ens_opt"].update(e_grads, o_state["ensembles"], p["ensembles"])
             p = {**p, "ensembles": optax.apply_updates(p["ensembles"], e_updates)}
             o_state = {**o_state, "ensembles": new_e_opt}
-        p, o_state, pl, vl = behavior_update(p, o_state, aux["latents"], data["terminated"], k_beh)
+        if p2e is not None:
+            # exploration policy ("actor" — the one the player acts with)
+            # learns the intrinsic return; the task policy learns extrinsic
+            p, o_state, pl_e, vl_e = behavior_update(
+                p, o_state, aux["latents"], data["terminated"], k_beh,
+                actor_key="actor", critic_key="critic_exploration", reward_kind="intrinsic",
+            )
+            p, o_state, pl_t, vl_t = behavior_update(
+                p, o_state, aux["latents"], data["terminated"], k_task,
+                actor_key="actor_task", critic_key="critic", reward_kind="extrinsic",
+            )
+            pl, vl = pl_e + pl_t, vl_e + vl_t
+        else:
+            p, o_state, pl, vl = behavior_update(
+                p, o_state, aux["latents"], data["terminated"], k_beh
+            )
         zero = jnp.zeros(())
         metrics = (
             wm_l, aux["observation_loss"], aux["reward_loss"], aux["kl_loss"],

@@ -430,6 +430,63 @@ class Actor(nn.Module):
         ]
         return jnp.concatenate(parts, axis=-1)
 
+    def sample_masked(
+        self,
+        head_out: jax.Array,
+        key: jax.Array,
+        masks: Dict[str, jax.Array],
+        greedy: bool = False,
+    ) -> jax.Array:
+        """MineDojo-style masked sampling (reference: dreamer_v3/agent.py
+        MinedojoActor.forward) — fully vectorized, no Python loops over the
+        batch, so it jits onto the host player unchanged.
+
+        Branch 0 (the compound action) is masked by ``mask_action_type``;
+        branch 1 (the craft argument) by ``mask_craft_smelt`` but only where
+        branch 0 sampled the craft action; branch 2 (the inventory argument)
+        by ``mask_equip_place`` / ``mask_destroy`` where branch 0 sampled
+        equip/place / destroy.  Masks arrive as float observations (the env
+        exposes them as obs keys); nonzero means allowed.  Masking happens
+        AFTER the unimix so excluded actions get exactly zero probability.
+        """
+        from sheeprl_tpu.envs.minedojo import (
+            FN_CRAFT,
+            FN_DESTROY,
+            FN_EQUIP,
+            FN_PLACE,
+            N_MOVEMENT_ACTIONS,
+        )
+
+        def masked(logits: jax.Array, allowed: jax.Array) -> OneHotCategorical:
+            return OneHotCategorical(jnp.where(allowed > 0, logits, -1e9))
+
+        dists = self.dists(head_out)  # unimix already folded into .logits
+        keys = jax.random.split(key, len(dists))
+        d0 = masked(dists[0].logits, masks["mask_action_type"])
+        a0 = d0.mode() if greedy else d0.sample(keys[0])
+        compound_idx = jnp.argmax(a0, -1)
+        parts = [a0]
+
+        if len(dists) > 1:  # craft/smelt argument
+            is_craft = (compound_idx == N_MOVEMENT_ACTIONS + FN_CRAFT - 1)[..., None]
+            allowed = jnp.where(is_craft, masks["mask_craft_smelt"] > 0, True)
+            d1 = masked(dists[1].logits, allowed)
+            parts.append(d1.mode() if greedy else d1.sample(keys[1]))
+        if len(dists) > 2:  # inventory-item argument
+            is_equip_place = (
+                (compound_idx == N_MOVEMENT_ACTIONS + FN_EQUIP - 1)
+                | (compound_idx == N_MOVEMENT_ACTIONS + FN_PLACE - 1)
+            )[..., None]
+            is_destroy = (compound_idx == N_MOVEMENT_ACTIONS + FN_DESTROY - 1)[..., None]
+            allowed = jnp.where(
+                is_equip_place,
+                masks["mask_equip_place"] > 0,
+                jnp.where(is_destroy, masks["mask_destroy"] > 0, True),
+            )
+            d2 = masked(dists[2].logits, allowed)
+            parts.append(d2.mode() if greedy else d2.sample(keys[2]))
+        return jnp.concatenate(parts, axis=-1)
+
     def log_prob(self, head_out: jax.Array, actions: jax.Array) -> jax.Array:
         dists = self.dists(head_out)
         if self.is_continuous:

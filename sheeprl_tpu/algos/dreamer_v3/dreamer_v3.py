@@ -142,6 +142,11 @@ def dreamer_family_loop(
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
 
     # ---------------- host player --------------------------------------------
+    # MineDojo-style action masking: the mask observations (exposed as mlp
+    # keys) constrain the player's sampling (reference: MinedojoActor)
+    use_action_masks = bool(cfg.algo.actor.get("action_masks", False))
+    mask_keys = ("mask_action_type", "mask_craft_smelt", "mask_equip_place", "mask_destroy")
+
     @partial(jax.jit, static_argnames=("greedy",))
     def player_step(p, carry, obs, k, greedy=False):
         """(h, z, prev_action) carry; returns new carry + env-space action."""
@@ -154,7 +159,12 @@ def dreamer_family_loop(
         )
         latent = jnp.concatenate([z, h], -1)
         head = actor.apply(p["actor"], latent)
-        action = actor.sample(head, k_act, greedy=greedy)
+        if use_action_masks:
+            action = actor.sample_masked(
+                head, k_act, {mk: obs[mk] for mk in mask_keys}, greedy=greedy
+            )
+        else:
+            action = actor.sample(head, k_act, greedy=greedy)
         return (h, z, action), action
 
     def init_player_carry(batch: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -189,7 +199,7 @@ def dreamer_family_loop(
 
     # ---------------- replay buffer ------------------------------------------
     seq_len = int(cfg.algo.per_rank_sequence_length)
-    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
+    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
     if cfg.buffer.get("type", "sequential") == "episode":
         rb = EpisodeBuffer(
             max(int(cfg.buffer.size), seq_len * 4),
@@ -303,20 +313,10 @@ def dreamer_family_loop(
                     if dones[i]:
                         continue
                     # the stream broke: the next stored step starts a new
-                    # episode whatever the buffer type
+                    # episode, and the buffer truncates (or drops) the
+                    # partial one — see ReplayBuffer/EpisodeBuffer.repair_tail
                     step_data["is_first"][:, i] = 1.0
-                    if isinstance(rb, EpisodeBuffer):
-                        # the open episode is unfinishable — drop it
-                        rb._open[i] = None
-                    else:
-                        sub = rb.buffer[i]
-                        if len(sub) > 0 and "truncated" in sub:
-                            tail = (sub._pos - 1) % sub.buffer_size
-                            sub._buf["truncated"][tail] = 1.0
-                            sub._buf["terminated"][tail] = 0.0
-                            # the patched row must not ALSO start an episode
-                            # (reference: dreamer_v3.py:605-607)
-                            sub._buf["is_first"][tail] = 0.0
+                    rb.repair_tail(i)
 
             for ep_ret, ep_len in episode_stats(info):
                 aggregator.update("Rewards/rew_avg", ep_ret)

@@ -6,6 +6,7 @@ from typing import Any, Dict
 
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_agent as base_build_agent
 from sheeprl_tpu.algos.dreamer_v3.evaluate import _evaluate_dreamer
+from sheeprl_tpu.algos.p2e_utils import choose_actor
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
@@ -13,4 +14,4 @@ from sheeprl_tpu.utils.registry import register_evaluation
 def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
     agent = dict(state["agent"])
     agent.pop("ensembles", None)
-    _evaluate_dreamer(fabric, cfg, {"agent": agent}, base_build_agent)
+    _evaluate_dreamer(fabric, cfg, {"agent": choose_actor(agent, cfg)}, base_build_agent)

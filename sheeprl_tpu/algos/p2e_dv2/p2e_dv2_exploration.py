@@ -1,13 +1,18 @@
 """Plan2Explore over DreamerV2 — exploration phase
-(reference: sheeprl/algos/p2e_dv2/p2e_dv2_exploration.py).
+(reference: sheeprl/algos/p2e_dv2/p2e_dv2_exploration.py:236-431).
 
 An ensemble of N forward models is trained to predict the next stochastic
-state from the current latent; its prediction variance is the intrinsic
-reward, mixed into the imagined returns with configured weights while the
-ensembles train alongside the world model.  Simplification vs the reference
-(documented): a single actor/critic learns the MIXED intrinsic+extrinsic
-return instead of the per-reward critic dict (the full dict lives in the
-DV3 variant, sheeprl_tpu/algos/p2e_dv3).
+state from (latent ⊕ action); its prediction variance is the intrinsic
+reward.  Two separate policies train every step, matching the reference:
+
+* the EXPLORATION actor (the one the player acts with) with its own
+  ``critic_exploration`` and hard-copied ``target_critic_exploration``
+  learns the pure intrinsic return;
+* the TASK actor (``actor_task``) with the task critic/target learns the
+  extrinsic return, so finetuning starts from a task policy.
+
+Both run inside DreamerV2's single-dispatch scanned train phase via the
+``p2e`` hook (see dreamer_v2.make_train_phase).
 """
 
 from __future__ import annotations
@@ -27,14 +32,39 @@ def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
     world_model, actor, critic, params = base_build_agent(
         fabric, actions_dim, is_continuous, cfg, obs_space, state
     )
+    rec = cfg.algo.world_model.recurrent_model.recurrent_state_size
+    latent_dim = world_model.stoch_flat + rec
+    key = jax.random.PRNGKey(cfg.seed + 1)
+    k_ens, k_actor, k_critic = jax.random.split(key, 3)
+    dummy_latent = jnp.zeros((1, latent_dim))
     if state is not None:
+        # resume path: backfill P2E-only params a pre-dual-policy
+        # checkpoint may lack
+        saved = jax.device_get(params)
+        missing = {}
+        if "actor_task" not in saved:
+            missing["actor_task"] = actor.init(k_actor, dummy_latent)
+        if "critic_exploration" not in saved:
+            missing["critic_exploration"] = critic.init(k_critic, dummy_latent)
+        if "target_critic_exploration" not in saved:
+            expl = missing.get("critic_exploration", saved.get("critic_exploration"))
+            missing["target_critic_exploration"] = jax.tree.map(jnp.copy, expl)
+        if missing:
+            params = fabric.replicate({**saved, **missing})
         return world_model, actor, critic, params
     ens = _ensemble(cfg, world_model)
-    rec = cfg.algo.world_model.recurrent_model.recurrent_state_size
-    latent_dim = world_model.stoch_flat + rec + int(sum(actions_dim))
-    ens_params = ens.init(jax.random.PRNGKey(cfg.seed + 1), jnp.zeros((1, latent_dim)))
+    ens_params = ens.init(k_ens, jnp.zeros((1, latent_dim + int(sum(actions_dim)))))
     params = jax.device_get(params)
-    params = {**params, "ensembles": ens_params}
+    critic_expl = critic.init(k_critic, dummy_latent)
+    params = {
+        **params,
+        "ensembles": ens_params,
+        # "actor" is the exploration policy (the player acts with it);
+        # the task policy trains alongside on extrinsic rewards
+        "actor_task": actor.init(k_actor, dummy_latent),
+        "critic_exploration": critic_expl,
+        "target_critic_exploration": jax.tree.map(jnp.copy, critic_expl),
+    }
     return world_model, actor, critic, fabric.replicate(params)
 
 
@@ -69,8 +99,6 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         "ens_module": _ensemble(cfg, world_model),
         "ens_opt": build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
         "n": int(cfg.algo.ensembles.n),
-        "w_intrinsic": float(cfg.algo.critics_exploration.intrinsic.weight),
-        "w_extrinsic": float(cfg.algo.critics_exploration.extrinsic.weight),
         "multiplier": float(cfg.algo.intrinsic_reward_multiplier),
     }
     return base_make_train_phase(
@@ -84,14 +112,17 @@ def build_optimizers(fabric, cfg, params, saved=None):
     actor_opt = build_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
     critic_opt = build_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
     ens_opt = build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients)
+    factories = {
+        "world_model": lambda: wm_opt.init(params["world_model"]),
+        "actor": lambda: actor_opt.init(params["actor"]),
+        "actor_task": lambda: actor_opt.init(params["actor_task"]),
+        "critic": lambda: critic_opt.init(params["critic"]),
+        "critic_exploration": lambda: critic_opt.init(params["critic_exploration"]),
+        "ensembles": lambda: ens_opt.init(params["ensembles"]),
+    }
+    # saved states from pre-dual-policy checkpoints lack the new entries
     opt_state = fabric.replicate(
-        saved
-        or {
-            "world_model": wm_opt.init(params["world_model"]),
-            "actor": actor_opt.init(params["actor"]),
-            "critic": critic_opt.init(params["critic"]),
-            "ensembles": ens_opt.init(params["ensembles"]),
-        }
+        {k: (saved[k] if saved and k in saved else init()) for k, init in factories.items()}
     )
     return wm_opt, actor_opt, critic_opt, opt_state
 

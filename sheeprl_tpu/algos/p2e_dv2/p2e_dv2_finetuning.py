@@ -1,14 +1,29 @@
 """Plan2Explore over DreamerV2 — finetuning phase
-(reference: sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py)."""
+(reference: sheeprl/algos/p2e_dv2/p2e_dv2_finetuning.py).
+
+Reloads the exploration checkpoint's world model and — by default — its
+TASK actor/critic/target (``algo.player.actor_type=task``; ``exploration``
+starts from the exploration policy instead, as the reference does before
+its learning-starts switch) and continues with standard DreamerV2
+training."""
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Dict
 
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import build_agent as base_build_agent
 from sheeprl_tpu.algos.dreamer_v2.dreamer_v2 import make_train_phase as base_make_train_phase
+from sheeprl_tpu.algos.p2e_utils import actor_type_from_cfg, project_exploration_state
 from sheeprl_tpu.config.compose import ConfigError
 from sheeprl_tpu.utils.registry import register_algorithm
+
+
+def exploration_state_to_dv2(state: Dict[str, Any], actor_type: str = "task") -> Dict[str, Any]:
+    """Project an exploration-phase checkpoint onto the DV2 state layout
+    (world model + TASK critic/target, actor chosen by ``actor_type``)."""
+    return project_exploration_state(
+        state, actor_type, keep_keys=("world_model", "critic", "target_critic")
+    )
 
 
 @register_algorithm(name="p2e_dv2_finetuning")
@@ -19,11 +34,9 @@ def main(fabric: Any, cfg: Any) -> None:
     initial_state = None
     if ckpt_path:
         raw = fabric.load(ckpt_path)
-        agent = dict(raw["agent"])
-        agent.pop("ensembles", None)
-        initial_state = {"agent": agent}
-        if cfg.buffer.get("load_from_exploration", False) and "rb" in raw:
-            initial_state["rb"] = raw["rb"]
+        initial_state = exploration_state_to_dv2(raw, actor_type=actor_type_from_cfg(cfg))
+        if not cfg.buffer.get("load_from_exploration", False):
+            initial_state.pop("rb", None)
     elif not cfg.checkpoint.resume_from:
         raise ConfigError("p2e finetuning needs checkpoint.exploration_ckpt_path")
     dreamer_family_loop(

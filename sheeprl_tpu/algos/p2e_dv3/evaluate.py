@@ -6,14 +6,13 @@ from typing import Any, Dict
 
 from sheeprl_tpu.algos.dreamer_v3.evaluate import _evaluate_dreamer
 from sheeprl_tpu.algos.p2e_dv3.p2e_dv3_exploration import build_agent
+from sheeprl_tpu.algos.p2e_utils import choose_actor
 from sheeprl_tpu.utils.registry import register_evaluation
 
 
 @register_evaluation(algorithms=["p2e_dv3_exploration", "p2e_dv3_finetuning"], name="p2e_dv3")
 def evaluate(fabric: Any, cfg: Any, state: Dict[str, Any]) -> None:
-    agent = state["agent"]
-    if "actor_task" in agent and cfg.algo.get("player", {}).get("actor_type", "task") == "task":
-        agent = {**agent, "actor": agent["actor_task"]}
+    agent = choose_actor(state["agent"], cfg)
     if "moments" not in agent:
         from sheeprl_tpu.algos.dreamer_v3.agent import build_agent as dv3_build
 

@@ -17,25 +17,18 @@ from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import (
     dreamer_family_loop,
     make_train_phase as dv3_make_train_phase,
 )
+from sheeprl_tpu.algos.p2e_utils import actor_type_from_cfg, project_exploration_state
 from sheeprl_tpu.config.compose import ConfigError
 from sheeprl_tpu.utils.registry import register_algorithm
 
 
 def exploration_state_to_dv3(state: Dict[str, Any], actor_type: str = "task") -> Dict[str, Any]:
     """Project an exploration-phase checkpoint onto the DV3 state layout."""
-    agent = dict(state.get("agent", {}))
-    chosen_actor = agent.get("actor_task") if actor_type == "task" else agent.get("actor")
-    dv3_agent = {
-        "world_model": agent["world_model"],
-        "actor": chosen_actor if chosen_actor is not None else agent["actor"],
-        "critic": agent["critic"],
-        "target_critic": agent["target_critic"],
-        "moments": agent.get("moments", {"low": 0.0, "high": 0.0}),
-    }
-    out = {"agent": dv3_agent}
-    if "rb" in state:
-        out["rb"] = state["rb"]
-    return out
+    return project_exploration_state(
+        state, actor_type,
+        keep_keys=("world_model", "critic", "target_critic"),
+        defaults={"moments": {"low": 0.0, "high": 0.0}},
+    )
 
 
 @register_algorithm(name="p2e_dv3_finetuning")
@@ -44,9 +37,7 @@ def main(fabric: Any, cfg: Any) -> None:
     initial_state = None
     if ckpt_path:
         raw = fabric.load(ckpt_path)
-        initial_state = exploration_state_to_dv3(
-            raw, actor_type=cfg.algo.get("player", {}).get("actor_type", "task")
-        )
+        initial_state = exploration_state_to_dv3(raw, actor_type=actor_type_from_cfg(cfg))
         if not cfg.buffer.get("load_from_exploration", False):
             initial_state.pop("rb", None)
     elif not cfg.checkpoint.resume_from:

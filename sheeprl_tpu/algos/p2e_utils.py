@@ -1,0 +1,47 @@
+"""Shared Plan2Explore state plumbing.
+
+Every P2E variant stores TWO policies in its exploration checkpoint: the
+exploration actor under ``"actor"`` (the one the player acts with during
+exploration) and the task policy under ``"actor_task"``.  Evaluation and
+finetuning pick between them via ``algo.player.actor_type``
+(reference: sheeprl/algos/p2e_dv*/p2e_dv*_finetuning.py switch to the task
+actor; evaluation honors the configured type).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence
+
+
+def actor_type_from_cfg(cfg: Any) -> str:
+    return cfg.algo.get("player", {}).get("actor_type", "task")
+
+
+def choose_actor(agent: Dict[str, Any], cfg: Any) -> Dict[str, Any]:
+    """Swap the task actor into the ``"actor"`` slot when configured (and
+    available — pre-dual-policy checkpoints only carry ``"actor"``)."""
+    if "actor_task" in agent and actor_type_from_cfg(cfg) == "task":
+        return {**agent, "actor": agent["actor_task"]}
+    return agent
+
+
+def project_exploration_state(
+    state: Dict[str, Any],
+    actor_type: str,
+    keep_keys: Sequence[str],
+    defaults: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Project an exploration checkpoint onto a base-Dreamer state layout:
+    keep ``keep_keys`` (world model, task critic/target, ...), select the
+    actor by ``actor_type``, and fill ``defaults`` for keys the checkpoint
+    may predate."""
+    agent = dict(state.get("agent", {}))
+    chosen_actor = agent.get("actor_task") if actor_type == "task" else agent.get("actor")
+    projected = {k: agent[k] for k in keep_keys if k in agent}
+    for k, v in (defaults or {}).items():
+        projected.setdefault(k, agent.get(k, v))
+    projected["actor"] = chosen_actor if chosen_actor is not None else agent["actor"]
+    out = {"agent": projected}
+    if "rb" in state:
+        out["rb"] = state["rb"]
+    return out

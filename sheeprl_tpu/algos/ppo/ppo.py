@@ -282,14 +282,14 @@ def main(fabric: Any, cfg: Any) -> None:
             rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
             rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
             rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            if num_envs % fabric.world_size == 0:
+            if num_envs % fabric.local_world_size == 0:
                 rollout = fabric.shard_batch(rollout, axis=1)  # shard over envs
             else:
                 rollout = fabric.replicate(rollout)
             last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
 
             T, B = rollout_steps, num_envs
-            global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
+            global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.local_world_size, T * B)
             num_minibatches = -(-T * B // global_bs)  # ceil: keep the tail
             key, tk = jax.random.split(key)
             params, opt_state, last_losses = train_phase(

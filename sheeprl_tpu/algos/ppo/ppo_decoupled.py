@@ -225,11 +225,11 @@ def main(fabric: Any, cfg: Any) -> None:
         return obs, rollout, key
 
     T, B = rollout_steps, num_envs
-    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
+    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.local_world_size, T * B)
     num_minibatches = -(-T * B // global_bs)
 
     def ship(rollout):
-        if num_envs % fabric.world_size == 0:
+        if num_envs % fabric.local_world_size == 0:
             return fabric.shard_batch(rollout, axis=1)
         return fabric.replicate(rollout)
 

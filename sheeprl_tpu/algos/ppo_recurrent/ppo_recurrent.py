@@ -218,7 +218,7 @@ def main(fabric: Any, cfg: Any) -> None:
     player_params = fabric.to_host(params)
     last_losses = None
 
-    env_bs = max(1, min(num_envs, (int(cfg.algo.per_rank_batch_size) * fabric.world_size) // rollout_steps))
+    env_bs = max(1, min(num_envs, (int(cfg.algo.per_rank_batch_size) * fabric.local_world_size) // rollout_steps))
     num_minibatches = -(-num_envs // env_bs)
 
     for update in range(start_iter, total_iters + 1):

@@ -217,7 +217,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
-    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
+    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
 
     # ---------------- main loop ---------------------------------------------
     obs, _ = envs.reset(seed=cfg.seed)

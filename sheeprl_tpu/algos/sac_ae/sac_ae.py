@@ -272,7 +272,7 @@ def main(fabric: Any, cfg: Any) -> None:
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
-    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.world_size
+    batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
 
     obs, _ = envs.reset(seed=cfg.seed)
     last_losses = None

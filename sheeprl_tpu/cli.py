@@ -28,6 +28,17 @@ from sheeprl_tpu.utils.registry import (
 from sheeprl_tpu.utils.structured import deep_merge, dotdict
 
 
+def import_extra_modules(cfg: dotdict) -> None:
+    """Import user packages listed in ``algo.extra_modules`` so their
+    ``@register_algorithm`` / ``@register_evaluation`` decorators run —
+    the external-algorithm extension point (reference behavior:
+    sheeprl/cli.py registration-at-import + howto/register_external_algorithm.md)."""
+    import importlib
+
+    for mod in cfg.get("algo", {}).get("extra_modules", []) or []:
+        importlib.import_module(mod)
+
+
 def check_configs(cfg: dotdict) -> None:
     """Config sanity validation (reference: sheeprl/cli.py:271-345)."""
     if "algo" not in cfg or cfg.algo.get("name") in (None, "???"):
@@ -87,6 +98,7 @@ def run_algorithm(cfg: dotdict) -> None:
     from sheeprl_tpu.parallel.fabric import build_fabric
 
     sheeprl_tpu.register_all_algorithms()
+    import_extra_modules(cfg)
     entry = resolve_algorithm(cfg.algo.name, decoupled=cfg.fabric.get("decoupled"))
     entrypoint = resolve_entrypoint(entry)
 
@@ -104,6 +116,7 @@ def run(argv: Optional[List[str]] = None) -> None:
     import sheeprl_tpu
 
     sheeprl_tpu.register_all_algorithms()
+    import_extra_modules(cfg)
     check_configs(cfg)
     from sheeprl_tpu.utils.utils import print_config
 
@@ -144,6 +157,7 @@ def evaluation(argv: Optional[List[str]] = None) -> None:
     from sheeprl_tpu.parallel.fabric import build_fabric
 
     sheeprl_tpu.register_all_algorithms()
+    import_extra_modules(cfg)
     entries = evaluation_registry.get(cfg.algo.name)
     if not entries:
         raise ConfigError(
@@ -177,6 +191,7 @@ def registration(argv: Optional[List[str]] = None) -> None:
     import sheeprl_tpu
 
     sheeprl_tpu.register_all_algorithms()
+    import_extra_modules(cfg)
     entry = resolve_algorithm(cfg.algo.name)
     try:
         utils_mod = importlib.import_module(entry.module.rsplit(".", 1)[0] + ".utils")

@@ -213,6 +213,19 @@ class ReplayBuffer:
                     out[f"next_{k}"] = np.asarray(self._buf[k])[next_idx, env_idx]
         return out
 
+    def repair_tail(self, env: int = 0) -> None:
+        """Mark the last written step as a truncation: called when the data
+        stream breaks mid-episode (e.g. a crashed-and-restarted env) so the
+        stored partial episode never bootstraps across the break.  The
+        patched row must not also start an episode (reference behavior:
+        sheeprl/algos/dreamer_v3/dreamer_v3.py:595-608)."""
+        if len(self) == 0:
+            return
+        tail = (self._pos - 1) % self._buffer_size
+        for key, value in (("truncated", 1.0), ("terminated", 0.0), ("is_first", 0.0)):
+            if key in self._buf:
+                self._buf[key][tail, env] = value
+
     def sample_tensors(self, batch_size: int, dtype: Optional[Any] = None, device: Optional[Any] = None, **kwargs: Any) -> Dict[str, Any]:
         return to_device(self.sample(batch_size, **kwargs), dtype=dtype, device=device)
 
@@ -378,6 +391,10 @@ class EnvIndependentReplayBuffer:
         keys = parts[0].keys()
         return {k: np.concatenate([p[k] for p in parts], axis=self._concat_along) for k in keys}
 
+    def repair_tail(self, env: int) -> None:
+        """See :meth:`ReplayBuffer.repair_tail` — applied to one env stream."""
+        self._buffers[env].repair_tail(env=0)
+
     def sample_tensors(self, batch_size: int, dtype: Optional[Any] = None, device: Optional[Any] = None, **kwargs: Any) -> Dict[str, Any]:
         return to_device(self.sample(batch_size, **kwargs), dtype=dtype, device=device)
 
@@ -471,6 +488,11 @@ class EpisodeBuffer:
                     self._open[env][k].append(v)
                 if bool(done[t, col].reshape(-1)[0] if hasattr(done[t, col], "reshape") else done[t, col]):
                     self._commit(env)
+
+    def repair_tail(self, env: int) -> None:
+        """The stream for ``env`` broke mid-episode: the open (uncommitted)
+        episode can never be finished — discard it."""
+        self._open[env] = None
 
     def _commit(self, env: int) -> None:
         open_ep = self._open[env]

@@ -140,7 +140,9 @@ class MineRLWrapper(gym.Env):
         if "navigate" not in id.lower():
             kwargs.pop("extreme", None)
 
-        self.env = _make_backend(id, break_speed_multiplier, **kwargs)
+        self.env = _make_backend(
+            id, break_speed_multiplier, resolution=(height, width), **kwargs
+        )
         self.actions_map, self._noop = build_action_map(self.env.action_space)
         self.action_space = spaces.Discrete(len(self.actions_map))
 

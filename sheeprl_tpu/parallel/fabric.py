@@ -143,6 +143,17 @@ class Fabric:
         return int(np.prod(list(self.mesh.shape.values())))
 
     @property
+    def local_world_size(self) -> int:
+        """Mesh devices owned by THIS process.  Data sizing must use this,
+        not ``world_size``: under multi-host, each process contributes its
+        own local shard and ``shard_batch`` assembles the global batch from
+        the per-process locals — sampling ``per_rank * world_size`` rows per
+        process would multiply the global batch by ``num_processes``.
+        Single-process, this equals ``world_size``."""
+        me = jax.process_index()
+        return int(sum(1 for d in self.mesh.devices.flat if d.process_index == me))
+
+    @property
     def global_rank(self) -> int:
         return jax.process_index()
 

@@ -120,6 +120,64 @@ class FileSystemModelManager(AbstractModelManager):
         return sorted(p.name for p in self.root.iterdir() if p.is_dir())
 
 
+def _run_best_metric(run_dir: Path, metric: str) -> Optional[float]:
+    """Best (max) value of ``metric`` logged by a run, from metrics.csv
+    (CSV backend) or TensorBoard event files when the reader is available."""
+    best: Optional[float] = None
+    csv_path = run_dir / "metrics.csv"
+    if csv_path.exists():
+        import csv as _csv
+
+        with open(csv_path) as f:
+            for row in _csv.DictReader(f):
+                if row.get("name") == metric:
+                    v = float(row["value"])
+                    best = v if best is None else max(best, v)
+        return best
+    try:
+        from tensorboard.backend.event_processing.event_accumulator import EventAccumulator
+    except Exception:
+        return None
+    for events in run_dir.glob("events.out.tfevents.*"):
+        acc = EventAccumulator(str(events))
+        acc.Reload()
+        if metric in acc.Tags().get("scalars", ()):
+            vals = [s.value for s in acc.Scalars(metric)]
+            if vals:
+                m = max(vals)
+                best = m if best is None else max(best, m)
+    return best
+
+
+def register_best_models(
+    log_dir: str,
+    cfg: Any,
+    metric: str = "Rewards/rew_avg",
+    models_keys: Optional[set] = None,
+) -> Dict[str, int]:
+    """Scan every run under ``log_dir`` (``**/version_*``), pick the one
+    whose logged ``metric`` peaked highest, and register that run's last
+    checkpointed sub-models (reference: sheeprl/utils/mlflow.py
+    register_best_models — same behavior against the MLflow backend)."""
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    best_run, best_val = None, None
+    for vdir in sorted(Path(log_dir).glob("**/version_*")):
+        ckpts = sorted((vdir / "checkpoint").glob("ckpt_*.ckpt"))
+        if not ckpts:
+            continue
+        val = _run_best_metric(vdir, metric)
+        if val is None:
+            continue
+        if best_val is None or val > best_val:
+            best_run, best_val = ckpts[-1], val
+    if best_run is None:
+        return {}
+    state = load_checkpoint(best_run)
+    versions = register_model_from_checkpoint(None, cfg, state, models_keys=models_keys)
+    return versions
+
+
 def register_model_from_checkpoint(
     fabric: Any, cfg: Any, state: Dict[str, Any], models_keys: Optional[set] = None
 ) -> Dict[str, int]:

@@ -1,0 +1,115 @@
+"""Mask-aware MineDojo action sampling (reference: MinedojoActor).
+
+The masks arrive as float observations; sampling must give exactly zero
+probability to excluded actions, and the argument branches must only be
+constrained when the corresponding compound action was selected."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.algos.dreamer_v3.agent import Actor
+from sheeprl_tpu.envs.minedojo import (
+    FN_CRAFT,
+    FN_DESTROY,
+    N_MOVEMENT_ACTIONS,
+)
+
+N_CRAFT, N_ITEMS = 5, 7
+ACTIONS_DIM = (19, N_CRAFT, N_ITEMS)
+
+
+def _actor_and_head(batch=64):
+    actor = Actor(
+        actions_dim=ACTIONS_DIM, is_continuous=False, dense_units=16,
+        mlp_layers=1, act="silu", layer_norm=False, unimix=0.01,
+        min_std=0.1, max_std=1.0, init_std=0.0, action_clip=1.0,
+        dtype=jnp.float32,
+    )
+    latent = jnp.zeros((batch, 8))
+    params = actor.init(jax.random.PRNGKey(0), latent)
+    head = actor.apply(params, latent)
+    return actor, head
+
+
+def _split(sample):
+    a0 = sample[..., :19]
+    a1 = sample[..., 19:19 + N_CRAFT]
+    a2 = sample[..., 19 + N_CRAFT:]
+    return a0, a1, a2
+
+
+def test_action_type_mask_zeroes_excluded():
+    actor, head = _actor_and_head()
+    mask_action = np.ones((64, 19), np.float32)
+    mask_action[:, 12:] = 0.0  # no functional actions legal
+    masks = {
+        "mask_action_type": jnp.asarray(mask_action),
+        "mask_craft_smelt": jnp.ones((64, N_CRAFT)),
+        "mask_equip_place": jnp.ones((64, N_ITEMS)),
+        "mask_destroy": jnp.ones((64, N_ITEMS)),
+    }
+    for seed in range(5):
+        sample = actor.sample_masked(head, jax.random.PRNGKey(seed), masks)
+        a0, _, _ = _split(np.asarray(sample))
+        assert a0[:, 12:].sum() == 0.0  # excluded actions never sampled
+
+
+def test_craft_mask_applies_only_on_craft_action():
+    actor, head = _actor_and_head()
+    craft_compound = N_MOVEMENT_ACTIONS + FN_CRAFT - 1
+    # force the craft compound action via the action-type mask
+    mask_action = np.zeros((64, 19), np.float32)
+    mask_action[:, craft_compound] = 1.0
+    craft_mask = np.zeros((64, N_CRAFT), np.float32)
+    craft_mask[:, 2] = 1.0  # only item 2 craftable
+    masks = {
+        "mask_action_type": jnp.asarray(mask_action),
+        "mask_craft_smelt": jnp.asarray(craft_mask),
+        "mask_equip_place": jnp.ones((64, N_ITEMS)),
+        "mask_destroy": jnp.ones((64, N_ITEMS)),
+    }
+    sample = actor.sample_masked(head, jax.random.PRNGKey(1), masks)
+    a0, a1, _ = _split(np.asarray(sample))
+    assert (a0.argmax(-1) == craft_compound).all()
+    assert (a1.argmax(-1) == 2).all()
+
+    # with a movement action forced instead, the craft arg is unconstrained
+    mask_action = np.zeros((64, 19), np.float32)
+    mask_action[:, 1] = 1.0  # forward only
+    masks["mask_action_type"] = jnp.asarray(mask_action)
+    sample = actor.sample_masked(head, jax.random.PRNGKey(2), masks)
+    _, a1, _ = _split(np.asarray(sample))
+    assert len(np.unique(a1.argmax(-1))) > 1  # not pinned to item 2
+
+
+def test_destroy_mask_constrains_inventory_arg():
+    actor, head = _actor_and_head()
+    destroy_compound = N_MOVEMENT_ACTIONS + FN_DESTROY - 1
+    mask_action = np.zeros((64, 19), np.float32)
+    mask_action[:, destroy_compound] = 1.0
+    destroy_mask = np.zeros((64, N_ITEMS), np.float32)
+    destroy_mask[:, 4] = 1.0
+    masks = {
+        "mask_action_type": jnp.asarray(mask_action),
+        "mask_craft_smelt": jnp.ones((64, N_CRAFT)),
+        "mask_equip_place": jnp.zeros((64, N_ITEMS)),  # irrelevant for destroy
+        "mask_destroy": jnp.asarray(destroy_mask),
+    }
+    sample = actor.sample_masked(head, jax.random.PRNGKey(3), masks)
+    _, _, a2 = _split(np.asarray(sample))
+    assert (a2.argmax(-1) == 4).all()
+
+
+def test_greedy_masked_mode():
+    actor, head = _actor_and_head(batch=4)
+    mask_action = np.ones((4, 19), np.float32)
+    masks = {
+        "mask_action_type": jnp.asarray(mask_action),
+        "mask_craft_smelt": jnp.ones((4, N_CRAFT)),
+        "mask_equip_place": jnp.ones((4, N_ITEMS)),
+        "mask_destroy": jnp.ones((4, N_ITEMS)),
+    }
+    s1 = actor.sample_masked(head, jax.random.PRNGKey(0), masks, greedy=True)
+    s2 = actor.sample_masked(head, jax.random.PRNGKey(9), masks, greedy=True)
+    np.testing.assert_array_equal(np.asarray(s1), np.asarray(s2))  # key-independent

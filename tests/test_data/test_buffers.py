@@ -173,6 +173,15 @@ class TestEpisodeBuffer:
         with pytest.raises(RuntimeError):
             eb.sample(1)
 
+    def test_repair_tail_drops_open_episode(self):
+        eb = EpisodeBuffer(100, sequence_length=2, n_envs=1)
+        data = self.make_episode_data(6)
+        data["dones"][-1] = 0.0  # still open
+        eb.add(data)
+        eb.repair_tail(0)
+        assert eb._open[0] is None
+        assert len(eb) == 0
+
     def test_truncated_commits_without_terminated_key(self):
         # 'dones' + 'truncated' data (no 'terminated'): a truncation alone
         # must close the episode (reference: data/buffers.py EpisodeBuffer.add
@@ -206,6 +215,36 @@ class TestReviewRegressions:
         for _ in range(10):  # must never crash by picking env 1
             batch = rb.sample(4, sequence_length=8)
             assert batch["obs"].shape == (1, 8, 4, 3)
+
+
+class TestRepairTail:
+    def _dreamer_step(self, t, n_envs=1):
+        d = make_step(t, n_envs=n_envs)
+        d["terminated"] = np.zeros((1, n_envs, 1), np.float32)
+        d["truncated"] = np.zeros((1, n_envs, 1), np.float32)
+        d["is_first"] = np.ones((1, n_envs, 1), np.float32) * (t == 0)
+        return d
+
+    def test_replay_buffer_repair_tail(self):
+        rb = ReplayBuffer(8, n_envs=2)
+        for t in range(3):
+            rb.add(self._dreamer_step(t, n_envs=2))
+        rb.repair_tail(env=1)
+        assert rb["truncated"][2, 1, 0] == 1.0 and rb["truncated"][2, 0, 0] == 0.0
+        assert rb["terminated"][2, 1, 0] == 0.0
+        assert rb["is_first"][2, 1, 0] == 0.0
+
+    def test_repair_tail_empty_buffer_noop(self):
+        ReplayBuffer(8, n_envs=1).repair_tail(0)
+
+    def test_env_independent_repair_tail(self):
+        rb = EnvIndependentReplayBuffer(16, n_envs=2, buffer_cls=SequentialReplayBuffer)
+        for t in range(4):
+            rb.add(self._dreamer_step(t, n_envs=1), indices=[0])
+            rb.add(self._dreamer_step(t, n_envs=1), indices=[1])
+        rb.repair_tail(0)
+        assert rb.buffer[0]["truncated"][3, 0, 0] == 1.0
+        assert rb.buffer[1]["truncated"][3, 0, 0] == 0.0
 
 
 class TestEpisodeBufferMemmap:

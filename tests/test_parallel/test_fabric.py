@@ -55,6 +55,12 @@ def test_to_host_never_aliases():
     assert host_copy.unsafe_buffer_pointer() != x.unsafe_buffer_pointer()
 
 
+def test_local_world_size_single_process():
+    fab = Fabric(devices=4, accelerator="cpu")
+    # single-process: every mesh device is local
+    assert fab.local_world_size == fab.world_size == 4
+
+
 def test_shard_batch_multihost_path(monkeypatch):
     # Force the process_count()>1 branch: host_local_array_to_global_array is
     # the sanctioned multi-host assembly path and must produce the same

@@ -37,3 +37,63 @@ def test_missing_model_raises(tmp_path):
     mm = FileSystemModelManager(tmp_path / "registry")
     with pytest.raises(FileNotFoundError):
         mm.load_model("nope")
+
+
+def test_register_best_models(tmp_path):
+    """register_best_models picks the run whose metric peaked highest and
+    registers that run's last checkpoint's sub-models."""
+    import csv
+
+    from sheeprl_tpu.utils.checkpoint import save_checkpoint
+    from sheeprl_tpu.utils.model_manager import register_best_models
+    from sheeprl_tpu.utils.structured import dotdict
+
+    log_dir = tmp_path / "runs"
+    for run, (reward, w) in {"a": (10.0, 1.0), "b": (99.0, 2.0)}.items():
+        vdir = log_dir / run / "version_0"
+        (vdir / "checkpoint").mkdir(parents=True)
+        with open(vdir / "metrics.csv", "w", newline="") as f:
+            wr = csv.writer(f)
+            wr.writerow(["step", "name", "value"])
+            wr.writerow([1, "Rewards/rew_avg", reward / 2])
+            wr.writerow([2, "Rewards/rew_avg", reward])
+        save_checkpoint(
+            vdir / "checkpoint" / "ckpt_2_0.ckpt",
+            {"agent": {"actor": {"w": jnp.full(2, w)}}},
+        )
+    cfg = dotdict(
+        {
+            "algo": {"name": "ppo"},
+            "env": {"id": "CartPole-v1"},
+            "seed": 0,
+            "model_manager": {"registry_root": str(tmp_path / "registry")},
+        }
+    )
+    versions = register_best_models(str(log_dir), cfg, metric="Rewards/rew_avg")
+    assert versions == {"actor": 1}
+    mm = FileSystemModelManager(tmp_path / "registry")
+    best = mm.load_model("ppo_actor")
+    assert float(jnp.asarray(best["w"])[0]) == 2.0  # run "b" won
+
+
+def test_extra_modules_import(tmp_path, monkeypatch):
+    """algo.extra_modules imports user packages so external algorithms
+    register (howto/register_external_algorithm.md)."""
+    import sys
+
+    from sheeprl_tpu.cli import import_extra_modules
+    from sheeprl_tpu.utils.structured import dotdict
+
+    (tmp_path / "ext_algo_pkg.py").write_text(
+        "from sheeprl_tpu.utils.registry import register_algorithm\n"
+        "@register_algorithm(name='ext_algo')\n"
+        "def main(fabric, cfg):\n"
+        "    pass\n"
+    )
+    monkeypatch.syspath_prepend(str(tmp_path))
+    import_extra_modules(dotdict({"algo": {"extra_modules": ["ext_algo_pkg"]}}))
+    from sheeprl_tpu.utils.registry import algorithm_registry
+
+    assert "ext_algo" in algorithm_registry
+    algorithm_registry.pop("ext_algo", None)
+    sys.modules.pop("ext_algo_pkg", None)
