@@ -44,6 +44,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
 )
 from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
 from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
     MSEDistribution,
@@ -137,7 +138,7 @@ def dreamer_family_loop(
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    host = fabric.player_device(cfg)
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
 
@@ -174,7 +175,10 @@ def dreamer_family_loop(
             np.zeros((batch, act_width), np.float32),
         )
 
-    player_params = fabric.to_host({"world_model": params["world_model"], "actor": params["actor"]})
+    psync = PlayerSync(
+        fabric, cfg, extract=lambda p: {"world_model": p["world_model"], "actor": p["actor"]}
+    )
+    player_params = psync.init(params)
     player_carry = init_player_carry(num_envs)
 
     def player_test_step(p, carry, obs, k, greedy):
@@ -373,6 +377,10 @@ def dreamer_family_loop(
                 per_rank_gradient_steps = 1 if update == total_iters else 0
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
+                    # deferred sync: pull the PREVIOUS window's weights (that
+                    # dispatch has finished) so the env steps above overlapped
+                    # with it (see PlayerSync)
+                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(
                         batch_size,
                         n_samples=per_rank_gradient_steps,
@@ -400,9 +408,7 @@ def dreamer_family_loop(
                         params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = fabric.to_host(
-                        {"world_model": params["world_model"], "actor": params["actor"]}
-                    )
+                    player_params = psync.after_dispatch(params, update, player_params)
 
         # ---------------- logging ---------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -458,6 +464,8 @@ def dreamer_family_loop(
 
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
+        # the deferred-sync player may be one window stale: sync once more
+        player_params = psync.init(params)
         test(player_test_step, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.sac.agent import build_agent, ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -112,7 +113,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     )
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    host = fabric.player_device(cfg)
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     target_entropy = -float(act_dim)
@@ -123,7 +124,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
         a, _ = sample_action(actor, p, obs, k, greedy=greedy)
         return a
 
-    player_params = fabric.to_host(params["actor"])
+    psync = PlayerSync(fabric, cfg, extract=lambda p: p["actor"])
+    player_params = psync.init(params)
 
     # ---------------- single-dispatch multi-update train phase --------------
     def one_update(carry, batch_and_key):
@@ -203,7 +205,6 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     if state:
         learning_starts += start_iter
 
-    player_sync_every = int(cfg.algo.get("player_sync_every", 1))
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
@@ -272,6 +273,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
+                    # deferred sync: pull the PREVIOUS window's weights (that
+                    # dispatch has finished) so the env steps above overlapped
+                    # with it (see PlayerSync)
+                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(
                         batch_size, n_samples=per_rank_gradient_steps
                     )  # (U, batch, *) block in one host call
@@ -288,13 +293,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    # decoupled topology: the player keeps acting on stale
-                    # weights for player_sync_every windows while the (async)
-                    # train dispatches run — the single-controller analogue of
-                    # the reference's trainer→player broadcast cadence
-                    # (reference: sac_decoupled.py:250-305)
-                    if update % player_sync_every == 0:
-                        player_params = fabric.to_host(params["actor"])
+                    player_params = psync.after_dispatch(params, update, player_params)
 
         # ---------------- logging -------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -344,7 +343,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         # the deferred-sync (decoupled) player may be stale: sync once more
-        player_params = fabric.to_host(params["actor"])
+        player_params = psync.init(params)
         test(actor, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

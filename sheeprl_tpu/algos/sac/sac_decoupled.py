@@ -3,10 +3,11 @@
 
 The reference splits rank-0 player from trainer ranks with TorchCollective
 scatter/broadcast.  Single-controller equivalent: train dispatches are
-asynchronous (the host never blocks on them), and the player's host params
-refresh only every ``algo.player_sync_every`` windows — the player interacts
-on stale weights while the device trains, exactly the reference's
-player↔trainer weight-refresh cadence without any process groups.
+asynchronous (the host never blocks on them), and the player's params
+refresh only every ``algo.player.sync_every`` windows (10 in this
+experiment's config) — the player interacts on stale weights while the
+device trains, exactly the reference's player↔trainer weight-refresh
+cadence without any process groups.
 """
 
 from __future__ import annotations
@@ -20,8 +21,6 @@ from sheeprl_tpu.utils.registry import register_algorithm
 
 @register_algorithm(decoupled=True, name="sac_decoupled")
 def main(fabric: Any, cfg: Any) -> None:
-    cfg.algo.setdefault("player_sync_every", 10)
-
     def plain_apply(critic, cp, o, a, k):
         return critic.apply(cp, o, a)
 

@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_block
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator
@@ -108,7 +109,7 @@ def main(fabric: Any, cfg: Any) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    host = fabric.player_device(cfg)
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     encoder_tau = float(cfg.algo.encoder.tau)
@@ -127,7 +128,10 @@ def main(fabric: Any, cfg: Any) -> None:
         a, _ = sample_action(actor, p["actor"], feats, k, greedy=greedy)
         return a
 
-    player_params = fabric.to_host({"encoder": params["encoder"], "actor": params["actor"]})
+    psync = PlayerSync(
+        fabric, cfg, extract=lambda p: {"encoder": p["encoder"], "actor": p["actor"]}
+    )
+    player_params = psync.init(params)
 
     # ---------------- one scanned update -------------------------------------
     def one_update(carry, batch_and_key):
@@ -318,6 +322,10 @@ def main(fabric: Any, cfg: Any) -> None:
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
+                    # deferred sync: pull the PREVIOUS window's weights (that
+                    # dispatch has finished) so the env steps above overlapped
+                    # with it (see PlayerSync)
+                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(batch_size, n_samples=per_rank_gradient_steps)
                     batches: Dict[str, jax.Array] = {
                         "actions": jnp.asarray(sample["actions"]),
@@ -341,9 +349,7 @@ def main(fabric: Any, cfg: Any) -> None:
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = fabric.to_host(
-                        {"encoder": params["encoder"], "actor": params["actor"]}
-                    )
+                    player_params = psync.after_dispatch(params, update, player_params)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
@@ -392,6 +398,8 @@ def main(fabric: Any, cfg: Any) -> None:
     if fabric.is_global_zero and cfg.algo.run_test:
         from sheeprl_tpu.algos.sac_ae.utils import test
 
+        # the deferred-sync player may be one window stale: sync once more
+        player_params = psync.init(params)
         test(encoder, actor, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

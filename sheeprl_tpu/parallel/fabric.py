@@ -181,21 +181,39 @@ class Fabric:
         return jax.local_devices(backend="cpu")[0]
 
     def to_host(self, tree: Any) -> Any:
-        """Copy a pytree to the host CPU device (one bulk transfer).
+        """Copy a pytree to the host CPU device (one bulk transfer)."""
+        return self.copy_to(tree, self.host_device)
 
-        ALWAYS a real copy: when the source already lives on the host device
-        (CPU runs), ``device_put`` would be a no-op alias — and the training
+    def copy_to(self, tree: Any, device: Any) -> Any:
+        """Copy a pytree onto ``device``.
+
+        ALWAYS a real copy: when the source already lives on the target
+        device, ``device_put`` would be a no-op alias — and the training
         step donates its params input, which would invalidate the player's
         copy mid-rollout.  ``.copy()`` breaks the alias.
         """
-        host = self.host_device
 
         def put(x: Any) -> Any:
-            if isinstance(x, jax.Array) and x.committed and set(x.devices()) == {host}:
+            if isinstance(x, jax.Array) and x.committed and set(x.devices()) == {device}:
                 return x.copy()
-            return jax.device_put(x, host)
+            return jax.device_put(x, device)
 
         return jax.tree.map(put, tree)
+
+    def player_device(self, cfg: Any) -> Any:
+        """The device the env-interaction player runs on.
+
+        ``algo.player.device=host`` (default) pins rollout inference to the
+        host CPU — the right call when device dispatch latency dominates
+        (tunneled chips, small models).  ``accelerator`` runs the player on
+        the first mesh device instead — the right call for big pixel
+        encoders on-pod, where the host would become the bottleneck."""
+        choice = (cfg.algo.get("player", {}) or {}).get("device", "host")
+        if choice == "accelerator":
+            return self.device
+        if choice != "host":
+            raise ValueError(f"algo.player.device must be 'host' or 'accelerator', got {choice!r}")
+        return self.host_device
 
     # -- sharding helpers --------------------------------------------------
     def sharding(self, *spec: Any) -> NamedSharding:
@@ -333,6 +351,53 @@ class Fabric:
 
         random.seed(seed)
         return jax.random.PRNGKey(seed)
+
+
+class PlayerSync:
+    """Overlap env interaction with (async-dispatched) device training.
+
+    JAX dispatches the train phase asynchronously; what serializes the loop
+    is pulling the fresh params to the player right after the dispatch — the
+    next ``player_step`` then blocks on the whole train phase.  In deferred
+    mode the pull happens at the START of the next optimization window
+    instead: the env steps of window N+1 run on window N-1's weights while
+    the device trains window N — the single-controller analogue of the
+    reference's decoupled trainer→player broadcast
+    (reference: sheeprl/algos/ppo/ppo_decoupled.py:32-365,
+    sac_decoupled.py:250-305).  One window of weight staleness, which is
+    exactly the decoupled topology's semantics; set
+    ``algo.player.deferred_sync=False`` for the strict coupled behavior.
+
+    ``sync_every`` additionally rate-limits refreshes to every k-th window
+    (``algo.player.sync_every``, sac_decoupled sets 10).
+    """
+
+    def __init__(self, fabric: "Fabric", cfg: Any, extract: Callable[[Any], Any]):
+        player_cfg = cfg.algo.get("player", {}) or {}
+        self.fabric = fabric
+        self.extract = extract
+        self.device = fabric.player_device(cfg)
+        self.deferred = bool(player_cfg.get("deferred_sync", True))
+        self.sync_every = max(1, int(player_cfg.get("sync_every", 1)))
+        self._pending: Any = None
+
+    def init(self, params: Any) -> Any:
+        return self.fabric.copy_to(self.extract(params), self.device)
+
+    def before_dispatch(self, player_params: Any) -> Any:
+        """Pull the previous window's (long since finished) train output."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            return self.fabric.copy_to(self.extract(pending), self.device)
+        return player_params
+
+    def after_dispatch(self, params: Any, update: int, player_params: Any) -> Any:
+        if update % self.sync_every != 0:
+            return player_params
+        if self.deferred:
+            self._pending = params
+            return player_params
+        return self.fabric.copy_to(self.extract(params), self.device)
 
 
 def _pickle_to_u8(obj: Any) -> np.ndarray:

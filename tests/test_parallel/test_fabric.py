@@ -72,6 +72,55 @@ def test_shard_batch_multihost_path(monkeypatch):
     np.testing.assert_array_equal(np.asarray(x).reshape(8, 4)[:, 0], np.arange(0, 32, 4))
 
 
+def test_player_sync_deferred_semantics():
+    from sheeprl_tpu.parallel.fabric import PlayerSync
+    from sheeprl_tpu.utils.structured import dotdict
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    cfg = dotdict({"algo": {"player": {"deferred_sync": True, "sync_every": 1, "device": "host"}}})
+    psync = PlayerSync(fab, cfg, extract=lambda p: p["actor"])
+    p0 = {"actor": jnp.zeros(2)}
+    player = psync.init(p0)
+    # dispatch window 1: deferred -> player unchanged, refresh pending
+    p1 = {"actor": jnp.ones(2)}
+    player = psync.after_dispatch(p1, update=1, player_params=player)
+    assert float(np.asarray(player)[0]) == 0.0
+    # window 2 start: the pending params land
+    player = psync.before_dispatch(player)
+    assert float(np.asarray(player)[0]) == 1.0
+    # nothing pending: no-op
+    assert psync.before_dispatch(player) is player
+
+
+def test_player_sync_immediate_and_cadence():
+    from sheeprl_tpu.parallel.fabric import PlayerSync
+    from sheeprl_tpu.utils.structured import dotdict
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    cfg = dotdict({"algo": {"player": {"deferred_sync": False, "sync_every": 2, "device": "host"}}})
+    psync = PlayerSync(fab, cfg, extract=lambda p: p["actor"])
+    player = psync.init({"actor": jnp.zeros(2)})
+    # off-cadence window: skipped entirely
+    player = psync.after_dispatch({"actor": jnp.ones(2)}, update=1, player_params=player)
+    assert float(np.asarray(player)[0]) == 0.0
+    # on-cadence window: immediate copy
+    player = psync.after_dispatch({"actor": jnp.ones(2)}, update=2, player_params=player)
+    assert float(np.asarray(player)[0]) == 1.0
+
+
+def test_player_device_selection():
+    from sheeprl_tpu.utils.structured import dotdict
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    assert fab.player_device(dotdict({"algo": {}})) == fab.host_device
+    assert (
+        fab.player_device(dotdict({"algo": {"player": {"device": "accelerator"}}}))
+        == fab.device
+    )
+    with pytest.raises(ValueError):
+        fab.player_device(dotdict({"algo": {"player": {"device": "gpu"}}}))
+
+
 def test_host_collectives_single_process():
     fab = Fabric(devices=2, accelerator="cpu")
     assert fab.broadcast_object({"a": 1}) == {"a": 1}
