@@ -84,7 +84,10 @@ def main(fabric: Any, cfg: Any) -> None:
     )
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    # on-policy loops honor algo.player.device (placement only; the sync
+    # cadence options are meaningless on-policy: rollouts must use the
+    # current weights)
+    host = fabric.player_device(cfg)
     reduction = cfg.algo.loss_reduction
     vf_coef = float(cfg.algo.vf_coef)
     ent_coef = float(cfg.algo.ent_coef)

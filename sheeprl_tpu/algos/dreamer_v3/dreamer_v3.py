@@ -138,7 +138,10 @@ def dreamer_family_loop(
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.player_device(cfg)
+    psync = PlayerSync(
+        fabric, cfg, extract=lambda p: {"world_model": p["world_model"], "actor": p["actor"]}
+    )
+    host = psync.device  # single resolution of algo.player.device
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
 
@@ -175,9 +178,6 @@ def dreamer_family_loop(
             np.zeros((batch, act_width), np.float32),
         )
 
-    psync = PlayerSync(
-        fabric, cfg, extract=lambda p: {"world_model": p["world_model"], "actor": p["actor"]}
-    )
     player_params = psync.init(params)
     player_carry = init_player_carry(num_envs)
 
@@ -249,6 +249,8 @@ def dreamer_family_loop(
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
+    if state and "psync" in state:
+        psync.load_state_dict(state["psync"])
 
     # ---------------- env bookkeeping (reference: dreamer_v3.py:540-657) ----
     obs, _ = envs.reset(seed=cfg.seed)
@@ -408,7 +410,7 @@ def dreamer_family_loop(
                         params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = psync.after_dispatch(params, update, player_params)
+                    player_params = psync.after_dispatch(params, player_params)
 
         # ---------------- logging ---------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -453,6 +455,7 @@ def dreamer_family_loop(
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "ratio": ratio.state_dict(),
+                "psync": psync.state_dict(),
                 "grad_steps": grad_step_counter,
             }
             fabric.call(

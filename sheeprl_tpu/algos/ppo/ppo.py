@@ -99,7 +99,10 @@ def main(fabric: Any, cfg: Any) -> None:
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
     # ---------------- host player (env-interaction policy) ------------------
-    host = fabric.host_device
+    # on-policy loops honor algo.player.device (placement only; the sync
+    # cadence options are meaningless on-policy: rollouts must use the
+    # current weights)
+    host = fabric.player_device(cfg)
 
     @partial(jax.jit, static_argnames=("greedy",))
     def policy_step_fn(p, obs, k, greedy=False):

@@ -91,7 +91,10 @@ def main(fabric: Any, cfg: Any) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    # on-policy loops honor algo.player.device (placement only; the sync
+    # cadence options are meaningless on-policy: rollouts must use the
+    # current weights)
+    host = fabric.player_device(cfg)
     reduction = cfg.algo.loss_reduction
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_adv = bool(cfg.algo.normalize_advantages)

@@ -112,7 +112,10 @@ def main(fabric: Any, cfg: Any) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.host_device
+    # on-policy loops honor algo.player.device (placement only; the sync
+    # cadence options are meaningless on-policy: rollouts must use the
+    # current weights)
+    host = fabric.player_device(cfg)
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
     vf_coef = float(cfg.algo.vf_coef)

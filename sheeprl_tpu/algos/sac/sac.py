@@ -113,7 +113,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     )
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.player_device(cfg)
+    psync = PlayerSync(fabric, cfg, extract=lambda p: p["actor"])
+    host = psync.device  # single resolution of algo.player.device
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     target_entropy = -float(act_dim)
@@ -124,7 +125,6 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
         a, _ = sample_action(actor, p, obs, k, greedy=greedy)
         return a
 
-    psync = PlayerSync(fabric, cfg, extract=lambda p: p["actor"])
     player_params = psync.init(params)
 
     # ---------------- single-dispatch multi-update train phase --------------
@@ -208,6 +208,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
+    if state and "psync" in state:
+        psync.load_state_dict(state["psync"])
 
     rb = ReplayBuffer(
         int(cfg.buffer.size) // num_envs,
@@ -293,7 +295,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = psync.after_dispatch(params, update, player_params)
+                    player_params = psync.after_dispatch(params, player_params)
 
         # ---------------- logging -------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -331,6 +333,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "ratio": ratio.state_dict(),
+                "psync": psync.state_dict(),
                 "grad_steps": grad_step_counter,
             }
             fabric.call(

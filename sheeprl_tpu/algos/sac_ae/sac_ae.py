@@ -109,7 +109,10 @@ def main(fabric: Any, cfg: Any) -> None:
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
     timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
 
-    host = fabric.player_device(cfg)
+    psync = PlayerSync(
+        fabric, cfg, extract=lambda p: {"encoder": p["encoder"], "actor": p["actor"]}
+    )
+    host = psync.device  # single resolution of algo.player.device
     gamma = float(cfg.algo.gamma)
     tau = float(cfg.algo.tau)
     encoder_tau = float(cfg.algo.encoder.tau)
@@ -128,9 +131,6 @@ def main(fabric: Any, cfg: Any) -> None:
         a, _ = sample_action(actor, p["actor"], feats, k, greedy=greedy)
         return a
 
-    psync = PlayerSync(
-        fabric, cfg, extract=lambda p: {"encoder": p["encoder"], "actor": p["actor"]}
-    )
     player_params = psync.init(params)
 
     # ---------------- one scanned update -------------------------------------
@@ -266,6 +266,8 @@ def main(fabric: Any, cfg: Any) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
+    if state and "psync" in state:
+        psync.load_state_dict(state["psync"])
 
     rb = ReplayBuffer(
         int(cfg.buffer.size) // num_envs,
@@ -349,7 +351,7 @@ def main(fabric: Any, cfg: Any) -> None:
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
                     grad_step_counter += per_rank_gradient_steps
-                    player_params = psync.after_dispatch(params, update, player_params)
+                    player_params = psync.after_dispatch(params, player_params)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
@@ -385,6 +387,7 @@ def main(fabric: Any, cfg: Any) -> None:
                 "last_log": last_log,
                 "last_checkpoint": last_checkpoint,
                 "ratio": ratio.state_dict(),
+                "psync": psync.state_dict(),
                 "grad_steps": grad_step_counter,
             }
             fabric.call(

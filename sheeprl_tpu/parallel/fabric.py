@@ -210,7 +210,11 @@ class Fabric:
         encoders on-pod, where the host would become the bottleneck."""
         choice = (cfg.algo.get("player", {}) or {}).get("device", "host")
         if choice == "accelerator":
-            return self.device
+            # PROCESS-LOCAL first device: self.device is globally enumerated
+            # and non-addressable from worker hosts in multi-host runs (the
+            # on-pod scenario this option exists for)
+            local = [d for d in jax.local_devices() if d.platform == self.accelerator]
+            return local[0] if local else self.device
         if choice != "host":
             raise ValueError(f"algo.player.device must be 'host' or 'accelerator', got {choice!r}")
         return self.host_device
@@ -364,12 +368,14 @@ class PlayerSync:
     the device trains window N — the single-controller analogue of the
     reference's decoupled trainer→player broadcast
     (reference: sheeprl/algos/ppo/ppo_decoupled.py:32-365,
-    sac_decoupled.py:250-305).  One window of weight staleness, which is
-    exactly the decoupled topology's semantics; set
+    sac_decoupled.py:250-305).  With ``sync_every=1`` that is one training
+    window of weight staleness — the decoupled topology's semantics; set
     ``algo.player.deferred_sync=False`` for the strict coupled behavior.
 
-    ``sync_every`` additionally rate-limits refreshes to every k-th window
-    (``algo.player.sync_every``, sac_decoupled sets 10).
+    ``sync_every`` additionally rate-limits refreshes to every k-th
+    TRAINING window (``algo.player.sync_every``, sac_decoupled sets 10);
+    the player then acts on weights up to k (+1 when deferred) training
+    windows old — the reference's player↔trainer refresh cadence.
     """
 
     def __init__(self, fabric: "Fabric", cfg: Any, extract: Callable[[Any], Any]):
@@ -380,6 +386,7 @@ class PlayerSync:
         self.deferred = bool(player_cfg.get("deferred_sync", True))
         self.sync_every = max(1, int(player_cfg.get("sync_every", 1)))
         self._pending: Any = None
+        self._windows = 0  # completed training windows (dispatches)
 
     def init(self, params: Any) -> Any:
         return self.fabric.copy_to(self.extract(params), self.device)
@@ -391,13 +398,33 @@ class PlayerSync:
             return self.fabric.copy_to(self.extract(pending), self.device)
         return player_params
 
-    def after_dispatch(self, params: Any, update: int, player_params: Any) -> Any:
-        if update % self.sync_every != 0:
+    def after_dispatch(self, params: Any, player_params: Any) -> Any:
+        # Gate on COMPLETED TRAINING WINDOWS, not the env-loop update counter:
+        # with a fractional replay_ratio the Ratio governor fires training on
+        # a fixed update parity, and an `update % sync_every` gate can then
+        # systematically never coincide with a training update (player runs
+        # on init weights forever).
+        self._windows += 1
+        if self._windows % self.sync_every != 0:
             return player_params
         if self.deferred:
             self._pending = params
             return player_params
         return self.fabric.copy_to(self.extract(params), self.device)
+
+    # -- checkpointing ------------------------------------------------------
+    def state_dict(self) -> Dict[str, int]:
+        """Cadence position, so a resumed run keeps FUTURE refreshes on the
+        same training-window parity as an uninterrupted one.  ``_pending``
+        is deliberately NOT saved: ``init`` on resume starts the player from
+        the checkpointed (latest) params — so at the resume point itself the
+        player is one refresh AHEAD of an uninterrupted run (which would
+        still act on the last on-cadence weights); exact mid-interval
+        staleness is not reproduced, only the refresh schedule."""
+        return {"windows": self._windows}
+
+    def load_state_dict(self, state: Dict[str, int]) -> None:
+        self._windows = int(state.get("windows", 0))
 
 
 def _pickle_to_u8(obj: Any) -> np.ndarray:

@@ -83,7 +83,7 @@ def test_player_sync_deferred_semantics():
     player = psync.init(p0)
     # dispatch window 1: deferred -> player unchanged, refresh pending
     p1 = {"actor": jnp.ones(2)}
-    player = psync.after_dispatch(p1, update=1, player_params=player)
+    player = psync.after_dispatch(p1, player_params=player)
     assert float(np.asarray(player)[0]) == 0.0
     # window 2 start: the pending params land
     player = psync.before_dispatch(player)
@@ -100,23 +100,51 @@ def test_player_sync_immediate_and_cadence():
     cfg = dotdict({"algo": {"player": {"deferred_sync": False, "sync_every": 2, "device": "host"}}})
     psync = PlayerSync(fab, cfg, extract=lambda p: p["actor"])
     player = psync.init({"actor": jnp.zeros(2)})
-    # off-cadence window: skipped entirely
-    player = psync.after_dispatch({"actor": jnp.ones(2)}, update=1, player_params=player)
+    # first completed training window: off-cadence (1 % 2), skipped entirely
+    player = psync.after_dispatch({"actor": jnp.ones(2)}, player_params=player)
     assert float(np.asarray(player)[0]) == 0.0
-    # on-cadence window: immediate copy
-    player = psync.after_dispatch({"actor": jnp.ones(2)}, update=2, player_params=player)
+    # second window: on-cadence, immediate copy
+    player = psync.after_dispatch({"actor": jnp.ones(2)}, player_params=player)
     assert float(np.asarray(player)[0]) == 1.0
 
 
-def test_player_device_selection():
+def test_player_sync_cadence_counts_training_windows_not_updates():
+    """The cadence gate must key on COMPLETED TRAINING WINDOWS: with a
+    fractional replay_ratio the env-loop update counter fires training on a
+    fixed parity, and an update-based gate could miss every training update
+    (player stuck on init weights — r2 review finding)."""
+    from sheeprl_tpu.parallel.fabric import PlayerSync
     from sheeprl_tpu.utils.structured import dotdict
 
     fab = Fabric(devices=1, accelerator="cpu")
-    assert fab.player_device(dotdict({"algo": {}})) == fab.host_device
-    assert (
-        fab.player_device(dotdict({"algo": {"player": {"device": "accelerator"}}}))
-        == fab.device
-    )
+    cfg = dotdict({"algo": {"player": {"deferred_sync": False, "sync_every": 2, "device": "host"}}})
+    psync = PlayerSync(fab, cfg, extract=lambda p: p)
+    player = psync.init(jnp.zeros(2))
+    # training fires on odd env updates only (replay_ratio 0.5): the sync
+    # must still happen on every 2nd *training* window
+    synced = 0
+    for window in range(1, 7):
+        player = psync.after_dispatch(jnp.full(2, float(window)), player_params=player)
+        if float(np.asarray(player)[0]) == float(window):
+            synced += 1
+    assert synced == 3  # windows 2, 4, 6
+
+
+def test_player_device_selection():
+    from unittest import mock
+
+    from sheeprl_tpu.utils.structured import dotdict
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    # on a CPU fabric host_device == device, so a wrong branch would be
+    # invisible; pin host_device to a sentinel to assert the branch taken
+    sentinel = object()
+    with mock.patch.object(type(fab), "host_device", new_callable=mock.PropertyMock, return_value=sentinel):
+        assert fab.player_device(dotdict({"algo": {}})) is sentinel
+        assert (
+            fab.player_device(dotdict({"algo": {"player": {"device": "accelerator"}}}))
+            is fab.device
+        )
     with pytest.raises(ValueError):
         fab.player_device(dotdict({"algo": {"player": {"device": "gpu"}}}))
 
