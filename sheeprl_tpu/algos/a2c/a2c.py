@@ -135,7 +135,9 @@ def main(fabric: Any, cfg: Any) -> None:
         return p, o_state, (pg, vl, e)
 
     rollout_steps = int(cfg.algo.rollout_steps)
-    policy_steps_per_iter = num_envs * rollout_steps
+    sharded_envs, _ = fabric.env_sharding_plan(num_envs, "A2C")
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -154,16 +156,22 @@ def main(fabric: Any, cfg: Any) -> None:
     )
 
     step_data: Dict[str, np.ndarray] = {}
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
 
     for update in range(start_iter, total_iters + 1):
         with timer("Time/env_interaction_time"):
             with jax.default_device(host):
                 for _ in range(rollout_steps):
-                    policy_step += num_envs
+                    policy_step += num_envs * fabric.num_processes
                     dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
                     actions_np = np.asarray(actions)
                     next_obs, rewards, terminated, truncated, info = envs.step(
@@ -204,11 +212,13 @@ def main(fabric: Any, cfg: Any) -> None:
             rollout["actions"] = jnp.asarray(local["actions"])
             rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
             rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            if num_envs % fabric.local_world_size == 0:
+            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
+            if sharded_envs:
+                # multi-host, each process contributes its local env rows
                 rollout = fabric.shard_batch(rollout, axis=1)
+                last_obs_dev = fabric.shard_batch(last_obs_dev, axis=0)
             else:
                 rollout = fabric.replicate(rollout)
-            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
             params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
             player_params = fabric.to_host(params)
 

@@ -230,7 +230,8 @@ def dreamer_family_loop(
         rb.load_state_dict({"buffers": state["rb"]}) if isinstance(state["rb"], list) else rb.load_state_dict(state["rb"])
 
     # ---------------- counters ------------------------------------------------
-    policy_steps_per_iter = num_envs * int(cfg.env.action_repeat)
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * int(cfg.env.action_repeat) * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         # dry run = collect just enough for one sequence sample (2x for the
@@ -253,7 +254,9 @@ def dreamer_family_loop(
         psync.load_state_dict(state["psync"])
 
     # ---------------- env bookkeeping (reference: dreamer_v3.py:540-657) ----
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     step_data: Dict[str, np.ndarray] = {}
     for k in obs_keys:
         step_data[k] = np.asarray(obs[k])[None]
@@ -283,6 +286,10 @@ def dreamer_family_loop(
                 with jax.default_device(host):
                     dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     new_carry, action_oh = player_step(
                         player_params,
                         tuple(jnp.asarray(c) for c in player_carry),

@@ -200,8 +200,14 @@ def main(fabric: Any, cfg: Any) -> None:
         return p, o_state, last_losses
 
     # ---------------- counters / schedules ----------------------------------
+    # the train phase is a GLOBAL program: its batch covers all ranks
+    sharded_envs, global_envs = fabric.env_sharding_plan(num_envs, "PPO")
     rollout_steps = int(cfg.algo.rollout_steps)
-    policy_steps_per_iter = num_envs * rollout_steps
+    T, B = rollout_steps, global_envs
+    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
+    num_minibatches = -(-T * B // global_bs)  # ceil: keep the tail
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -226,17 +232,23 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # ---------------- main loop ---------------------------------------------
     step_data: Dict[str, np.ndarray] = {}
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
 
     for update in range(start_iter, total_iters + 1):
         with timer("Time/env_interaction_time"):
             with jax.default_device(host):
                 for _ in range(rollout_steps):
-                    policy_step += num_envs
+                    policy_step += num_envs * fabric.num_processes
 
                     dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
                     actions_np = np.asarray(actions)
                     next_obs, rewards, terminated, truncated, info = envs.step(
@@ -285,15 +297,14 @@ def main(fabric: Any, cfg: Any) -> None:
             rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
             rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
             rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            if num_envs % fabric.local_world_size == 0:
-                rollout = fabric.shard_batch(rollout, axis=1)  # shard over envs
+            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
+            if sharded_envs:
+                # multi-host, each process contributes its local env rows and
+                # the global batch is their concatenation
+                rollout = fabric.shard_batch(rollout, axis=1)
+                last_obs_dev = fabric.shard_batch(last_obs_dev, axis=0)
             else:
                 rollout = fabric.replicate(rollout)
-            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
-
-            T, B = rollout_steps, num_envs
-            global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.local_world_size, T * B)
-            num_minibatches = -(-T * B // global_bs)  # ceil: keep the tail
             key, tk = jax.random.split(key)
             params, opt_state, last_losses = train_phase(
                 params,

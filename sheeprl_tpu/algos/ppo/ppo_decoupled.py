@@ -167,7 +167,8 @@ def main(fabric: Any, cfg: Any) -> None:
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
     rollout_steps = int(cfg.algo.rollout_steps)
-    policy_steps_per_iter = num_envs * rollout_steps
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -185,9 +186,13 @@ def main(fabric: Any, cfg: Any) -> None:
         nonlocal policy_step
         with jax.default_device(host):
             for _ in range(rollout_steps):
-                policy_step += num_envs
+                policy_step += num_envs * fabric.num_processes
                 dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
                 key, sk = jax.random.split(key)
+                # per-rank sampling: the shared key stream stays rank-identical
+                # (train-dispatch keys must agree across processes), so fold the
+                # rank into the PLAYER key only
+                sk = jax.random.fold_in(sk, rank)
                 actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
                 actions_np = np.asarray(actions)
                 next_obs, rewards, terminated, truncated, info = envs.step(
@@ -227,17 +232,21 @@ def main(fabric: Any, cfg: Any) -> None:
         rollout["dones"] = jnp.asarray(local["dones"][..., 0])
         return obs, rollout, key
 
-    T, B = rollout_steps, num_envs
-    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.local_world_size, T * B)
+    # the train phase is a GLOBAL program: its batch covers all ranks
+    sharded_envs, B = fabric.env_sharding_plan(num_envs, "decoupled PPO")
+    T = rollout_steps
+    global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
     num_minibatches = -(-T * B // global_bs)
 
-    def ship(rollout):
-        if num_envs % fabric.local_world_size == 0:
-            return fabric.shard_batch(rollout, axis=1)
+    def ship(rollout, axis=1):
+        if sharded_envs:
+            return fabric.shard_batch(rollout, axis=axis)
         return fabric.replicate(rollout)
 
     # ---------------- pipelined main loop -----------------------------------
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     player_params = fabric.to_host(params)
     last_losses = None
 
@@ -249,7 +258,8 @@ def main(fabric: Any, cfg: Any) -> None:
         with timer("Time/train_time"):
             key, tk = jax.random.split(key)
             params, opt_state, last_losses = train_phase(
-                params, opt_state, ship(rollout), prepare_obs(obs, cnn_keys, mlp_keys),
+                params, opt_state, ship(rollout),
+                ship(prepare_obs(obs, cnn_keys, mlp_keys), axis=0),
                 tk, jnp.float32(clip_coef_v), jnp.float32(ent_coef_v),
                 batch_size=global_bs, num_minibatches=num_minibatches,
             )

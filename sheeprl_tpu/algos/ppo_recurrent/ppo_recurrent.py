@@ -200,7 +200,8 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # ---------------- counters ----------------------------------------------
     rollout_steps = int(cfg.algo.rollout_steps)
-    policy_steps_per_iter = num_envs * rollout_steps
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -211,7 +212,9 @@ def main(fabric: Any, cfg: Any) -> None:
 
     rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=mlp_keys)
 
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     prev_actions = np.zeros((num_envs, act_width), np.float32)
     is_first = np.ones((num_envs, 1), np.float32)
     carry_np = (
@@ -221,20 +224,35 @@ def main(fabric: Any, cfg: Any) -> None:
     player_params = fabric.to_host(params)
     last_losses = None
 
-    env_bs = max(1, min(num_envs, (int(cfg.algo.per_rank_batch_size) * fabric.local_world_size) // rollout_steps))
-    num_minibatches = -(-num_envs // env_bs)
+    # the train phase is a GLOBAL program: under multi-host the env axis is
+    # the concatenation of every process's local envs.  Single-process keeps
+    # the replicated layout (env-axis minibatch gathers are cheapest there),
+    # so sharding kicks in only across processes.
+    sharded_envs = fabric.num_processes > 1
+    if sharded_envs:
+        fabric.env_sharding_plan(num_envs, "recurrent PPO")  # fail fast
+    global_envs = num_envs * (fabric.num_processes if sharded_envs else 1)
+    env_bs = max(
+        1,
+        min(global_envs, (int(cfg.algo.per_rank_batch_size) * fabric.world_size) // rollout_steps),
+    )
+    num_minibatches = -(-global_envs // env_bs)
 
     for update in range(start_iter, total_iters + 1):
         init_carry = (carry_np[0].copy(), carry_np[1].copy())
         with timer("Time/env_interaction_time"):
             with jax.default_device(host):
                 for _ in range(rollout_steps):
-                    policy_step += num_envs
+                    policy_step += num_envs * fabric.num_processes
                     dev_obs = {
                         k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
                         for k in mlp_keys
                     }
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     carry, actions, logprobs, _ = policy_step_fn(
                         player_params,
                         (jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
@@ -308,7 +326,10 @@ def main(fabric: Any, cfg: Any) -> None:
             rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
             rollout["dones"] = jnp.asarray(local["dones"][..., 0])
             rollout["is_first"] = jnp.asarray(local["is_first"])  # (T, B, 1)
-            rollout = fabric.replicate(rollout)
+            # single-process: replicate (the env-axis minibatch gathers are
+            # cheapest on replicated data); multi-host: each process only has
+            # its own env rows, so assemble the global env axis instead
+            rollout = fabric.shard_batch(rollout, axis=1) if sharded_envs else fabric.replicate(rollout)
 
             # bootstrap values for the state after the rollout
             dev_obs = {
@@ -321,10 +342,12 @@ def main(fabric: Any, cfg: Any) -> None:
                 is_first=jnp.asarray(is_first),
             )
             key, tk = jax.random.split(key)
+            carry_pair = (jnp.asarray(init_carry[0]), jnp.asarray(init_carry[1]))
+            last_v_flat = jnp.asarray(np.asarray(last_v)[..., 0])
             params, opt_state, last_losses = train_phase(
                 params, opt_state, rollout,
-                fabric.replicate((jnp.asarray(init_carry[0]), jnp.asarray(init_carry[1]))),
-                fabric.replicate(jnp.asarray(np.asarray(last_v)[..., 0])),
+                fabric.shard_batch(carry_pair, axis=0) if sharded_envs else fabric.replicate(carry_pair),
+                fabric.shard_batch(last_v_flat, axis=0) if sharded_envs else fabric.replicate(last_v_flat),
                 tk, jnp.float32(ent_coef_v), env_bs=env_bs, num_minibatches=num_minibatches,
             )
             player_params = fabric.to_host(params)

@@ -192,7 +192,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 
     # ---------------- counters ----------------------------------------------
-    policy_steps_per_iter = num_envs
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -223,12 +224,14 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
 
     # ---------------- main loop ---------------------------------------------
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
 
     for update in range(start_iter, total_iters + 1):
-        policy_step += num_envs
+        policy_step += num_envs * fabric.num_processes
         with timer("Time/env_interaction_time"):
             if update <= learning_starts and not state:
                 env_actions = np.stack([act_space.sample() for _ in range(num_envs)])
@@ -237,6 +240,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             else:
                 with jax.default_device(host):
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     actions = np.asarray(act_fn(player_params, jnp.asarray(obs_vec), sk))
                 env_actions = to_env_actions(actions)
             next_obs, rewards, terminated, truncated, info = envs.step(env_actions)

@@ -250,7 +250,8 @@ def main(fabric: Any, cfg: Any) -> None:
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 
     # ---------------- counters / buffer --------------------------------------
-    policy_steps_per_iter = num_envs
+    # GLOBAL env-step accounting: every process steps its own envs
+    policy_steps_per_iter = num_envs * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -280,11 +281,13 @@ def main(fabric: Any, cfg: Any) -> None:
 
     batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
 
-    obs, _ = envs.reset(seed=cfg.seed)
+    # rank-offset: each process's envs must be distinct streams or
+    # multi-host DP collects the same data num_processes times
+    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
 
     for update in range(start_iter, total_iters + 1):
-        policy_step += num_envs
+        policy_step += num_envs * fabric.num_processes
         with timer("Time/env_interaction_time"):
             if update <= learning_starts and not state:
                 env_actions = np.stack([act_space.sample() for _ in range(num_envs)])
@@ -293,6 +296,10 @@ def main(fabric: Any, cfg: Any) -> None:
             else:
                 with jax.default_device(host):
                     key, sk = jax.random.split(key)
+                    # per-rank sampling: the shared key stream stays rank-identical
+                    # (train-dispatch keys must agree across processes), so fold the
+                    # rank into the PLAYER key only
+                    sk = jax.random.fold_in(sk, rank)
                     actions = np.asarray(act_fn(player_params, _prep(obs, cnn_keys, mlp_keys), sk))
                 env_actions = to_env_actions(actions)
             next_obs, rewards, terminated, truncated, info = envs.step(env_actions)

@@ -194,6 +194,17 @@ class Fabric:
         """
 
         def put(x: Any) -> Any:
+            if isinstance(x, jax.Array) and not x.is_fully_addressable:
+                # multi-host global array: device_put rejects it.  Replicated
+                # arrays (params) carry the FULL value in every local shard —
+                # copy from the process-local one.
+                if not x.sharding.is_fully_replicated:
+                    raise ValueError(
+                        "copy_to got a non-replicated multi-host array; only "
+                        "replicated (player/param) trees can be copied to a "
+                        "single device"
+                    )
+                x = x.addressable_shards[0].data
             if isinstance(x, jax.Array) and x.committed and set(x.devices()) == {device}:
                 return x.copy()
             return jax.device_put(x, device)
@@ -350,11 +361,34 @@ class Fabric:
             print(*args, **kwargs)
 
     def seed_everything(self, seed: int) -> jax.Array:
-        np.random.seed(seed)
+        """Seed host RNGs PER-RANK and return the SHARED jax key.
+
+        The returned key seeds agent init and the train-dispatch stream,
+        which must be identical on every process: replicated inputs of the
+        global program (params, train keys) have to agree across ranks.
+        Host-side RNG (replay sampling, random prefill actions) must DIFFER
+        per rank or multi-host data parallelism collects/samples the same
+        data ``num_processes`` times.  Per-rank player sampling keys are
+        derived in the loops via ``fold_in(key, global_rank)``."""
+        np.random.seed(seed + self.global_rank)
         import random
 
-        random.seed(seed)
+        random.seed(seed + self.global_rank)
         return jax.random.PRNGKey(seed)
+
+    def env_sharding_plan(self, num_envs: int, algo: str = "") -> Tuple[bool, int]:
+        """Whether per-rank env rollouts can shard over the data axis, and
+        the GLOBAL env count the train program then sees.  Multi-host
+        requires shardability — validated here ONCE, before any rollout is
+        collected."""
+        sharded = num_envs % self.local_world_size == 0
+        if not sharded and self.num_processes > 1:
+            raise ValueError(
+                f"multi-host {algo or 'training'} requires env.num_envs "
+                f"({num_envs}) divisible by the local device count "
+                f"({self.local_world_size})"
+            )
+        return sharded, num_envs * (self.num_processes if sharded else 1)
 
 
 class PlayerSync:

@@ -265,7 +265,15 @@ def accelerator_alive(timeout_s: int = 90) -> bool:
     try:
         _ACCELERATOR_ALIVE = (
             subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
+                [
+                    sys.executable,
+                    "-c",
+                    # an actual dispatch, not just device enumeration: a
+                    # half-wedged tunnel can still LIST devices while any
+                    # real computation hangs forever
+                    "import jax, jax.numpy as jnp; jax.devices();"
+                    " (jnp.ones((8, 8)) * 2).block_until_ready()",
+                ],
                 timeout=timeout_s,
                 capture_output=True,
             ).returncode

@@ -24,6 +24,10 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line("markers", "slow: multi-process / long-running tests")
+
+
 @pytest.fixture(autouse=True)
 def _restore_env():
     """Detect and undo environment-variable leaks between tests."""

@@ -154,3 +154,35 @@ def test_host_collectives_single_process():
     assert fab.broadcast_object({"a": 1}) == {"a": 1}
     assert fab.all_gather_object("x") == ["x"]
     fab.barrier()  # no-op single process
+
+
+def test_seed_everything_rank_offsets_host_rng_only():
+    """Host RNG (replay sampling, random prefill) must differ per rank, while
+    the returned jax key (agent init + train-dispatch stream) must be
+    IDENTICAL on every process — replicated global-program inputs have to
+    agree across ranks (r2 review finding: rank-identical seeding made
+    multi-host DP collect the same data num_processes times)."""
+    from unittest import mock
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    draws, keys = [], []
+    for rank in (0, 1):
+        with mock.patch("jax.process_index", return_value=rank):
+            keys.append(np.asarray(fab.seed_everything(42)))
+            draws.append(np.random.random(4))
+    assert np.array_equal(keys[0], keys[1])  # shared jax stream
+    assert not np.allclose(draws[0], draws[1])  # per-rank host RNG
+
+
+def test_env_sharding_plan():
+    fab = Fabric(devices=2, accelerator="cpu")
+    sharded, global_envs = fab.env_sharding_plan(4, "PPO")
+    assert sharded and global_envs == 4  # single-process: no inflation
+    sharded, global_envs = fab.env_sharding_plan(3, "PPO")
+    assert not sharded and global_envs == 3  # falls back to replication
+    # multi-host: indivisible env counts must fail fast, BEFORE any rollout
+    from unittest import mock
+
+    with mock.patch("jax.process_count", return_value=2):
+        with pytest.raises(ValueError, match="divisible"):
+            fab.env_sharding_plan(3, "PPO")
