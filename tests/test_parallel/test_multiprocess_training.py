@@ -31,6 +31,27 @@ _ALGO_ARGS = {
         "algo.learning_starts=0",
         "algo.hidden_size=16",
     ],
+    # vector-obs DreamerV3 (no CNN): exercises the sequential-replay block
+    # assembly + per-rank sampling + PlayerSync paths multi-process
+    "dreamer_v3": [
+        "exp=dreamer_v3",
+        "env.id=discrete_dummy",
+        "algo=dreamer_v3_XS",
+        "algo.learning_starts=0",
+        "algo.replay_ratio=1",
+        "algo.per_rank_sequence_length=8",
+        "algo.horizon=4",
+        "algo.cnn_keys.encoder=[]",
+        "algo.dense_units=16",
+        "algo.mlp_layers=1",
+        "algo.world_model.encoder.cnn_channels_multiplier=2",
+        "algo.world_model.recurrent_model.recurrent_state_size=16",
+        "algo.world_model.transition_model.hidden_size=16",
+        "algo.world_model.representation_model.hidden_size=16",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "buffer.size=400",
+    ],
 }
 
 _WORKER = textwrap.dedent(
@@ -82,7 +103,7 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("algo", ["ppo", "sac"])
+@pytest.mark.parametrize("algo", ["ppo", "sac", "dreamer_v3"])
 def test_two_process_training(tmp_path, algo):
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
