@@ -170,15 +170,40 @@ class Decoder(nn.Module):
 
 
 class RecurrentModel(nn.Module):
-    """(z ⊕ a) → dense+LN+SiLU → LayerNormGRUCell (reference: agent.py:281-341)."""
+    """(z ⊕ a) → dense+LN+SiLU → LayerNormGRUCell (reference: agent.py:281-341).
+
+    ``fused_pallas`` runs the WHOLE path as one VMEM-resident Pallas kernel
+    (ops/rssm_pallas.py): both weight blocks live in VMEM and the ``(B, D)``
+    and ``(B, 3H)`` intermediates never round-trip HBM between the scan
+    steps.  NOTE: the fused path declares flat params (different checkpoint
+    layout than the flax submodules — pick the flag at model-creation time,
+    same caveat as LayerNormGRUCell.use_pallas).
+    """
 
     recurrent_size: int
     dense_units: int
-    use_pallas: bool = False  # fused VMEM-resident GRU kernel (TPU)
+    use_pallas: bool = False  # fused VMEM-resident GRU kernel only (TPU)
+    fused_pallas: bool = False  # full dense+LN+SiLU+GRU one-kernel path (TPU)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, h: jax.Array, x: jax.Array) -> jax.Array:
+        if self.fused_pallas:
+            from sheeprl_tpu.ops.rssm_pallas import fused_rssm_recurrent
+
+            D, H = self.dense_units, self.recurrent_size
+            w_in = self.param("in_kernel", trunk_init, (x.shape[-1], D), jnp.float32)
+            b_in = self.param("in_bias", nn.initializers.zeros_init(), (D,), jnp.float32)
+            ln_s = self.param("ln_scale", nn.initializers.ones_init(), (D,), jnp.float32)
+            ln_b = self.param("ln_bias", nn.initializers.zeros_init(), (D,), jnp.float32)
+            w_gru = self.param(
+                "gru_kernel", nn.initializers.lecun_normal(), (D + H, 3 * H), jnp.float32
+            )
+            g_s = self.param("gru_ln_scale", nn.initializers.ones_init(), (3 * H,), jnp.float32)
+            g_b = self.param("gru_ln_bias", nn.initializers.zeros_init(), (3 * H,), jnp.float32)
+            return fused_rssm_recurrent(
+                x, h, w_in, b_in, ln_s, ln_b, w_gru, g_s, g_b
+            ).astype(self.dtype)
         y = _dense(self.dense_units, self.dtype, "in")(x.astype(self.dtype))
         y = LayerNorm(dtype=self.dtype, eps=1e-3, name="ln")(y)
         y = nn.silu(y)
@@ -214,6 +239,7 @@ class WorldModel(nn.Module):
     learnable_initial_state: bool = True
     decoupled_rssm: bool = False
     use_pallas_gru: bool = False
+    fused_pallas_rssm: bool = False
     dtype: Any = jnp.float32
 
     @property
@@ -229,7 +255,8 @@ class WorldModel(nn.Module):
         )
         self.recurrent_model = RecurrentModel(
             recurrent_size=self.recurrent_size, dense_units=self.dense_units,
-            use_pallas=self.use_pallas_gru, dtype=self.dtype, name="recurrent_model",
+            use_pallas=self.use_pallas_gru, fused_pallas=self.fused_pallas_rssm,
+            dtype=self.dtype, name="recurrent_model",
         )
         # posterior: (h ⊕ embed) → logits; prior: h → logits
         self.representation_model = DreamerMLP(
@@ -562,6 +589,7 @@ def build_agent(
         learnable_initial_state=wm_cfg.learnable_initial_recurrent_state,
         decoupled_rssm=wm_cfg.decoupled_rssm,
         use_pallas_gru=bool(wm_cfg.recurrent_model.get("use_pallas", False)),
+        fused_pallas_rssm=bool(wm_cfg.recurrent_model.get("fused_pallas", False)),
         dtype=dtype,
     )
     actor = Actor(

@@ -79,6 +79,43 @@ def fused_layernorm_gru(
     return _fused_layernorm_gru(x, h, w, ln_scale, ln_bias, block_b, interpret)
 
 
+def _reference_math(x, h, w, ln_scale, ln_bias):
+    """Pure-JAX same-math path (fp32): autodiff source for the backward."""
+    f32 = jnp.float32
+    h = h.astype(f32)
+    inp = jnp.concatenate([x.astype(f32), h], axis=-1)
+    parts = jnp.dot(inp, w.astype(f32))
+    mean = jnp.mean(parts, axis=-1, keepdims=True)
+    var = jnp.mean((parts - mean) ** 2, axis=-1, keepdims=True)
+    parts = (parts - mean) * jax.lax.rsqrt(var + LN_EPS)
+    parts = parts * ln_scale.astype(f32).reshape(1, -1) + ln_bias.astype(f32).reshape(1, -1)
+    H = h.shape[-1]
+    reset = jax.nn.sigmoid(parts[:, :H])
+    cand = jnp.tanh(reset * parts[:, H:2 * H])
+    update = jax.nn.sigmoid(parts[:, 2 * H:] - 1.0)
+    return update * cand + (1.0 - update) * h
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _gru_core(x, h, w, ln_scale, ln_bias, block_b, interpret):
+    return _pallas_forward(x, h, w, ln_scale, ln_bias, block_b, interpret)
+
+
+def _gru_core_fwd(x, h, w, ln_scale, ln_bias, block_b, interpret):
+    out = _pallas_forward(x, h, w, ln_scale, ln_bias, block_b, interpret)
+    return out, (x, h, w, ln_scale, ln_bias)
+
+
+def _gru_core_bwd(block_b, interpret, residuals, g):
+    # pallas_call has no reverse-mode rule; differentiate the same math via
+    # XLA (what the flax path's backward is anyway)
+    _, vjp = jax.vjp(_reference_math, *residuals)
+    return vjp(g)
+
+
+_gru_core.defvjp(_gru_core_fwd, _gru_core_bwd)
+
+
 @functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
 def _fused_layernorm_gru(
     x: jax.Array,
@@ -98,6 +135,29 @@ def _fused_layernorm_gru(
     Returns:
         (B, H) new recurrent state (fp32).
     """
+    return _gru_core(x, h, w, ln_scale, ln_bias, block_b, interpret)
+
+
+# conservative VMEM budget for the resident weight block (see rssm_pallas)
+_VMEM_WEIGHT_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _pallas_forward(
+    x: jax.Array,
+    h: jax.Array,
+    w: jax.Array,
+    ln_scale: jax.Array,
+    ln_bias: jax.Array,
+    block_b: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    if 4 * w.size > _VMEM_WEIGHT_BUDGET_BYTES:
+        raise ValueError(
+            f"fused GRU kernel keeps the (D+H, 3H) weight VMEM-resident; "
+            f"{4 * w.size / 2**20:.1f} MB fp32 exceeds the "
+            f"{_VMEM_WEIGHT_BUDGET_BYTES / 2**20:.0f} MB budget — use the "
+            "flax cell (use_pallas=False) or shard H over the mesh."
+        )
     B, D = x.shape
     H = h.shape[-1]
     x = x.astype(jnp.float32)

@@ -1,0 +1,237 @@
+"""Pallas TPU kernel: fully-fused RSSM recurrent path.
+
+The RSSM recurrent step (reference: sheeprl/algos/dreamer_v3/agent.py:281-341
+``RecurrentModel``) is ``dense+LN+SiLU`` over ``z ⊕ a`` followed by the
+LayerNorm-GRU cell — two matmuls with elementwise tails, executed once per
+sequence step inside a ``lax.scan``.  XLA fuses each tail into its matmul but
+still stages the intermediate ``(B, D)`` activation and the ``(B, 3H)`` gate
+projection through HBM every step.  This kernel runs the WHOLE path in one
+``pallas_call``: both weight blocks stay resident in VMEM for every batch
+tile, the intermediates never leave VMEM, and the new recurrent state is the
+only output.
+
+Sizes (DreamerV3-S, fp32): W_in (1056, 512) ≈ 2.2 MB, W_gru (1024, 1536)
+≈ 6.3 MB → comfortably inside the ~16 MB VMEM budget.  M and larger
+presets exceed VMEM with fp32 weights ((1664, 3072) ≈ 20 MB) — the op
+raises a clear error instead of failing in the Mosaic compile; keeping the
+whole-weight-resident design honest means S-class models only.  Larger
+models need an H-tiled two-pass kernel (the 3H LayerNorm couples all gate
+columns) or a model-axis sharding — future work.
+
+Autodiff: ``pallas_call`` has no reverse-mode rule, so the op carries a
+``custom_vjp`` whose backward differentiates the SAME math via XLA.  The
+backward re-runs the forward (rematerialization semantics) — in gradient
+paths the fused kernel therefore trades a little recompute for the VMEM
+residency; the clear wins are the grad-free player/rollout and posterior
+paths, and any training setup already under ``jax.checkpoint``.  Decide
+per-preset with benchmarks/bench_gru_pallas.py on hardware.
+
+Numerics match the flax path exactly (fp32 throughout): input LN eps 1e-3,
+GRU LN eps 1e-5 (models.LayerNorm defaults), Hafner ``-1`` update-gate bias.
+Validated against the flax modules in tests/test_models/test_rssm_pallas.py
+with ``interpret=True`` (no TPU needed).  Enable inside the world model with
+``algo.world_model.recurrent_model.fused_pallas=True`` once on TPU hardware.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LN_IN_EPS = 1e-3   # RecurrentModel input LayerNorm (agent.py RecurrentModel)
+LN_GRU_EPS = 1e-5  # models.LayerNorm default (GRU projection LN)
+
+
+def _ln(x: jax.Array, scale: jax.Array, bias: jax.Array, eps: float) -> jax.Array:
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.mean((x - mean) ** 2, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+
+
+def _rssm_kernel(
+    x_ref, h_ref,
+    w_in_ref, b_in_ref, ln_in_scale_ref, ln_in_bias_ref,
+    w_gru_ref, gru_scale_ref, gru_bias_ref,
+    out_ref,
+):
+    """One batch tile of the fused recurrent path.
+
+    x: (Bt, Z+A) concatenated stochastic state + action;  h: (Bt, H);
+    w_in/b_in: (Z+A, D)/(1, D) input projection;  ln_in_*: (1, D);
+    w_gru: (D+H, 3H) fused GRU projection;  gru_*: (1, 3H) GRU LayerNorm.
+    """
+    x = x_ref[:]
+    h = h_ref[:]
+    # input projection + LN(1e-3) + SiLU — all VMEM-resident
+    y = jnp.dot(x, w_in_ref[:], preferred_element_type=jnp.float32) + b_in_ref[:]
+    y = _ln(y, ln_in_scale_ref[:], ln_in_bias_ref[:], LN_IN_EPS)
+    y = jax.nn.silu(y)
+    # LayerNorm-GRU (same math as ops/gru_pallas._gru_kernel)
+    inp = jnp.concatenate([y, h], axis=-1)
+    parts = jnp.dot(inp, w_gru_ref[:], preferred_element_type=jnp.float32)
+    parts = _ln(parts, gru_scale_ref[:], gru_bias_ref[:], LN_GRU_EPS)
+    H = h.shape[-1]
+    reset = jax.nn.sigmoid(parts[:, :H])
+    cand = jnp.tanh(reset * parts[:, H:2 * H])
+    update = jax.nn.sigmoid(parts[:, 2 * H:] - 1.0)
+    out_ref[:] = update * cand + (1.0 - update) * h
+
+
+def fused_rssm_recurrent(
+    x: jax.Array,
+    h: jax.Array,
+    w_in: jax.Array,
+    b_in: jax.Array,
+    ln_in_scale: jax.Array,
+    ln_in_bias: jax.Array,
+    w_gru: jax.Array,
+    gru_scale: jax.Array,
+    gru_bias: jax.Array,
+    block_b: int = 128,
+    interpret: bool = None,
+) -> jax.Array:
+    """Fused ``RecurrentModel`` forward: ``GRU(h, SiLU(LN(x @ W_in + b)))``.
+
+    Args:
+        x: (..., Z+A) inputs (z ⊕ action).  h: (..., H) recurrent state.
+        w_in/b_in: input Dense params.  ln_in_*: input LayerNorm params (D,).
+        w_gru: (D+H, 3H) fused GRU kernel.  gru_*: GRU LayerNorm params (3H,).
+    Returns:
+        (..., H) new recurrent state, fp32.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = x.shape[:-1]
+    if len(lead) > 1:
+        x = x.reshape(-1, x.shape[-1])
+        h = h.reshape(-1, h.shape[-1])
+        out = _fused_rssm_recurrent(
+            x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+            block_b, interpret,
+        )
+        return out.reshape(*lead, out.shape[-1])
+    return _fused_rssm_recurrent(
+        x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+        block_b, interpret,
+    )
+
+
+def _reference_math(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias):
+    """Pure-JAX implementation of the same math (fp32) — the autodiff source
+    for the kernel's backward pass and the numerical reference in tests."""
+    f32 = jnp.float32
+    y = jnp.dot(x.astype(f32), w_in.astype(f32)) + b_in.astype(f32).reshape(1, -1)
+    y = _ln(y, ln_in_scale.astype(f32).reshape(1, -1), ln_in_bias.astype(f32).reshape(1, -1), LN_IN_EPS)
+    y = jax.nn.silu(y)
+    h = h.astype(f32)
+    inp = jnp.concatenate([y, h], axis=-1)
+    parts = jnp.dot(inp, w_gru.astype(f32))
+    parts = _ln(parts, gru_scale.astype(f32).reshape(1, -1), gru_bias.astype(f32).reshape(1, -1), LN_GRU_EPS)
+    H = h.shape[-1]
+    reset = jax.nn.sigmoid(parts[:, :H])
+    cand = jnp.tanh(reset * parts[:, H:2 * H])
+    update = jax.nn.sigmoid(parts[:, 2 * H:] - 1.0)
+    return update * cand + (1.0 - update) * h
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(9, 10))
+def _rssm_core(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+               block_b, interpret):
+    return _pallas_forward(
+        x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+        block_b, interpret,
+    )
+
+
+def _rssm_core_fwd(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+                   block_b, interpret):
+    out = _pallas_forward(
+        x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+        block_b, interpret,
+    )
+    return out, (x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias)
+
+
+def _rssm_core_bwd(block_b, interpret, residuals, g):
+    # backward through the SAME math via XLA autodiff — pallas_call has no
+    # reverse-mode rule; XLA's fused backward is what the flax path uses too
+    _, vjp = jax.vjp(_reference_math, *residuals)
+    return vjp(g)
+
+
+_rssm_core.defvjp(_rssm_core_fwd, _rssm_core_bwd)
+
+
+@functools.partial(jax.jit, static_argnames=("block_b", "interpret"))
+def _fused_rssm_recurrent(
+    x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+    block_b: int = 128,
+    interpret: bool = False,
+):
+    return _rssm_core(
+        x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+        block_b, interpret,
+    )
+
+
+# conservative VMEM budget for the weight blocks (v5e has 16 MB/core; leave
+# headroom for activations and double-buffering)
+_VMEM_WEIGHT_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _pallas_forward(
+    x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+    block_b: int = 128,
+    interpret: bool = False,
+):
+    weight_bytes = 4 * (w_in.size + w_gru.size)
+    if weight_bytes > _VMEM_WEIGHT_BUDGET_BYTES:
+        raise ValueError(
+            f"fused RSSM kernel keeps both weight blocks VMEM-resident; this "
+            f"model needs {weight_bytes / 2**20:.1f} MB fp32 > "
+            f"{_VMEM_WEIGHT_BUDGET_BYTES / 2**20:.0f} MB budget.  Use the "
+            "flax path (fused_pallas=False) for M+ presets."
+        )
+    B, ZA = x.shape
+    H = h.shape[-1]
+    D = w_in.shape[-1]
+    f32 = jnp.float32
+    x = x.astype(f32)
+    h = h.astype(f32)
+    w_in = w_in.astype(f32)
+    b_in = b_in.reshape(1, D).astype(f32)
+    ln_in_scale = ln_in_scale.reshape(1, D).astype(f32)
+    ln_in_bias = ln_in_bias.reshape(1, D).astype(f32)
+    w_gru = w_gru.astype(f32)
+    gru_scale = gru_scale.reshape(1, 3 * H).astype(f32)
+    gru_bias = gru_bias.reshape(1, 3 * H).astype(f32)
+
+    bt = min(block_b, B)
+    pad = (-B) % bt
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+    grid = ((B + pad) // bt,)
+
+    out = pl.pallas_call(
+        _rssm_kernel,
+        out_shape=jax.ShapeDtypeStruct((B + pad, H), f32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, ZA), lambda i: (i, 0)),
+            pl.BlockSpec((bt, H), lambda i: (i, 0)),
+            pl.BlockSpec((ZA, D), lambda i: (0, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+            pl.BlockSpec((D + H, 3 * H), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias)
+    return out[:B]

@@ -65,3 +65,23 @@ def test_fused_gru_leading_batch_dims():
     out = fused_layernorm_gru(xt, ht, w, scale, bias, interpret=True)
     assert out.shape == (2, h0.shape[0], h0.shape[1])
     np.testing.assert_allclose(np.asarray(out[0]), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_gru_gradients_match_reference():
+    """pallas_call has no reverse-mode rule; the op's custom_vjp must give
+    the same gradients as the pure-math path (training differentiates
+    through the RSSM scan, so a forward-only op would crash training)."""
+    x, h0, w, scale, bias, _ = _flax_reference()
+
+    def loss_fused(x, h, w, s, b):
+        return jnp.sum(fused_layernorm_gru(x, h, w, s, b, interpret=True) ** 2)
+
+    from sheeprl_tpu.ops.gru_pallas import _reference_math
+
+    def loss_ref(x, h, w, s, b):
+        return jnp.sum(_reference_math(x, h, w, s, b) ** 2)
+
+    g_fused = jax.grad(loss_fused, argnums=(0, 1, 2, 3, 4))(x, h0, w, scale, bias)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2, 3, 4))(x, h0, w, scale, bias)
+    for a, b in zip(g_fused, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
