@@ -106,6 +106,37 @@ def run_algorithm(cfg: dotdict) -> None:
         jax.config.update("jax_default_matmul_precision", cfg.matmul_precision)
     fabric = build_fabric(cfg)
     entrypoint(fabric, cfg)
+    _maybe_register_models(fabric, cfg)
+
+
+def _maybe_register_models(fabric, cfg: dotdict) -> None:
+    """End-of-training model export (reference: sheeprl/algos/*/…
+    `register_model` hook at the end of every `main`, e.g. ppo.py:448-453):
+    when ``model_manager.disabled`` is False, the final checkpoint's
+    sub-models are registered with the configured names/descriptions."""
+    mm = cfg.get("model_manager") or {}
+    if mm.get("disabled", True) or (fabric is not None and not fabric.is_global_zero):
+        return
+    import glob
+
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from sheeprl_tpu.utils.model_manager import register_model_from_checkpoint
+
+    root = os.path.join(cfg.get("log_dir", "logs/runs"), str(cfg.get("root_dir")), str(cfg.get("run_name")))
+    versions = sorted(
+        glob.glob(os.path.join(root, "version_*")),
+        key=lambda p: int(p.rsplit("_", 1)[-1]),
+    )
+    for vdir in reversed(versions):
+        ckpts = sorted(
+            glob.glob(os.path.join(vdir, "checkpoint", "*.ckpt")), key=os.path.getmtime
+        )
+        if ckpts:
+            state = load_checkpoint(ckpts[-1])
+            out = register_model_from_checkpoint(fabric, cfg, state)
+            if out:
+                print(f"Registered models from {ckpts[-1]}: {out}")
+            return
 
 
 def run(argv: Optional[List[str]] = None) -> None:

@@ -337,6 +337,8 @@ def _resolve_ref(ref: str, tree: Mapping[str, Any], stack: Tuple[str, ...]) -> A
     if ref.startswith("eval:"):
         inner = _resolve_value(ref[len("eval:"):], tree, stack)
         return _safe_eval(str(inner))
+    if ref.startswith("oc.env:"):  # hydra/omegaconf-compatible alias
+        ref = "env:" + ref[len("oc.env:"):]
     if ref.startswith("env:"):
         body = ref[len("env:"):]
         var, _, default = body.partition(",")

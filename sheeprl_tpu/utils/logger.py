@@ -67,6 +67,53 @@ class TensorBoardLogger:
         self.writer.close()
 
 
+class MLflowLogger:
+    """Thin adapter over the optional ``mlflow`` package (reference:
+    sheeprl/configs/logger/mlflow.yaml + lightning MLFlowLogger). Requires
+    ``mlflow`` to be installed and ``MLFLOW_TRACKING_URI`` (or the
+    ``tracking_uri`` config key) to point at a tracking server."""
+
+    def __init__(self, log_dir: str, experiment_name: str = "default",
+                 tracking_uri: Optional[str] = None, run_name: Optional[str] = None):
+        try:
+            import mlflow
+        except ImportError as e:  # pragma: no cover - mlflow absent from image
+            raise ImportError(
+                "metric.logger=mlflow requires the optional `mlflow` package; "
+                "install it or use the tensorboard/csv loggers"
+            ) from e
+        self.log_dir = log_dir
+        self._mlflow = mlflow
+        if tracking_uri:
+            mlflow.set_tracking_uri(tracking_uri)
+        mlflow.set_experiment(experiment_name)
+        self._run = mlflow.start_run(run_name=run_name)
+
+    def log_metrics(self, metrics: Dict[str, float], step: int) -> None:
+        self._mlflow.log_metrics({k.replace("/", "_"): float(v) for k, v in metrics.items()}, step=step)
+
+    def log_hyperparams(self, params: Dict[str, Any]) -> None:
+        flat = {}
+
+        def walk(node, prefix=""):
+            if isinstance(node, dict):
+                for k, v in node.items():
+                    walk(v, f"{prefix}{k}.")
+            else:
+                flat[prefix[:-1]] = node
+
+        walk(params)
+        # mlflow caps param batches; log defensively
+        for k, v in flat.items():
+            try:
+                self._mlflow.log_param(k, v)
+            except Exception:
+                pass
+
+    def close(self) -> None:
+        self._mlflow.end_run()
+
+
 def get_log_dir(fabric: Any, root_dir: str, run_name: str, base: str = "logs/runs") -> str:
     """Create (on process 0) and agree on a versioned run directory."""
     root = os.path.join(base, root_dir, run_name)
@@ -97,4 +144,12 @@ def get_logger(fabric: Any, cfg: Any, log_dir: str) -> Optional[Any]:
             return CSVLogger(log_dir)
     if kind == "csv":
         return CSVLogger(log_dir)
+    if kind == "mlflow":
+        lcfg = cfg.metric.logger
+        return MLflowLogger(
+            log_dir,
+            experiment_name=lcfg.get("experiment_name") or cfg.get("exp_name", "default"),
+            tracking_uri=lcfg.get("tracking_uri"),
+            run_name=lcfg.get("run_name"),
+        )
     raise ValueError(f"Unknown logger kind: {kind}")

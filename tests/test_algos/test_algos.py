@@ -522,3 +522,35 @@ def test_dreamer_v3_resume_from_checkpoint(tmp_path):
     assert ckpts
     # resume restores params/opt/counters/ratio and the replay buffer
     run(args + [f"checkpoint.resume_from={ckpts[0]}"])
+
+
+def test_end_of_training_model_registration(tmp_path, monkeypatch):
+    """With model_manager.disabled=False the final checkpoint's sub-models are
+    exported to the registry with the configured names (reference:
+    end-of-`main` register_model hook, sheeprl/algos/ppo/ppo.py:448-453,
+    driven by configs/model_manager/ppo.yaml)."""
+    monkeypatch.chdir(tmp_path)
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=8",
+            "algo.run_test=False",
+            "model_manager.disabled=False",
+            f"model_manager.registry_root={tmp_path}/registry",
+        ],
+    )
+    run(args)
+    from sheeprl_tpu.utils.model_manager import FileSystemModelManager
+
+    manager = FileSystemModelManager(f"{tmp_path}/registry")
+    # exp_name = ppo_discrete_dummy → model_name from configs/model_manager/ppo.yaml
+    assert manager.get_latest_version("ppo_discrete_dummy_agent") == 1
+    params = manager.load_model("ppo_discrete_dummy_agent")
+    assert params is not None
