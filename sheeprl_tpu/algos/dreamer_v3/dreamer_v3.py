@@ -54,7 +54,7 @@ from sheeprl_tpu.utils.distribution import (
 )
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
@@ -435,19 +435,10 @@ def dreamer_family_loop(
                 aggregator.update("Loss/value_loss", vl)
                 aggregator.update("State/post_entropy", pe)
                 aggregator.update("State/prior_entropy", pre)
-            metrics = aggregator.compute()
-            aggregator.reset()
-            times = timer.to_dict(reset=True)
-            steps_since = max(policy_step - last_log, 1)
-            if "Time/env_interaction_time" in times:
-                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
-            if "Time/train_time" in times:
-                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
-            metrics["Params/replay_ratio"] = grad_step_counter * fabric.world_size / max(policy_step, 1)
-            metrics.update(times)
-            if logger is not None and metrics:
-                logger.log_metrics(metrics, policy_step)
-            last_log = policy_step
+            last_log = flush_metrics(
+                aggregator, timer, logger, policy_step, last_log,
+                extra_metrics={"Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1)},
+            )
 
         # ---------------- checkpoint ------------------------------------------
         if (

@@ -48,7 +48,7 @@ from sheeprl_tpu.algos.ppo.utils import (
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
@@ -338,18 +338,7 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/policy_loss", pg)
                 aggregator.update("Loss/value_loss", vl)
                 aggregator.update("Loss/entropy_loss", ent)
-            metrics = aggregator.compute()
-            aggregator.reset()
-            times = timer.to_dict(reset=True)
-            steps_since = max(policy_step - last_log, 1)
-            if "Time/env_interaction_time" in times:
-                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
-            if "Time/train_time" in times:
-                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
-            metrics.update(times)
-            if logger is not None and metrics:
-                logger.log_metrics(metrics, policy_step)
-            last_log = policy_step
+            last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
 
         # ---------------- checkpoint -----------------------------------------
         if (
@@ -379,10 +368,6 @@ def main(fabric: Any, cfg: Any) -> None:
 
 
 def _obs_to_device(arr: np.ndarray, is_image: bool) -> jax.Array:
-    x = np.asarray(arr)
-    if is_image:
-        if x.ndim == 6:  # (T, B, S, H, W, C) → (T, B, H, W, S*C)
-            t, b, s, h, w, c = x.shape
-            x = np.transpose(x, (0, 1, 3, 4, 2, 5)).reshape(t, b, h, w, s * c)
-        return jnp.asarray(x, jnp.float32) / 255.0
-    return jnp.asarray(x, jnp.float32)
+    from sheeprl_tpu.algos.ppo.utils import obs_to_np
+
+    return jnp.asarray(obs_to_np(arr, is_image, rollout=True))

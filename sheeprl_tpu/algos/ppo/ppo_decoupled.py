@@ -39,6 +39,7 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import (
     actions_for_env,
     normalize_obs_keys,
+    obs_to_np,
     prepare_obs,
     spaces_to_dims,
     test,
@@ -46,7 +47,7 @@ from sheeprl_tpu.algos.ppo.utils import (
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
 from sheeprl_tpu.utils.utils import polynomial_decay
 from sheeprl_tpu.utils.registry import register_algorithm
@@ -54,47 +55,9 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import gae, save_configs
 
 
-@register_algorithm(decoupled=True, name="ppo_decoupled")
-def main(fabric: Any, cfg: Any) -> None:
-    rank = fabric.global_rank
-    key = fabric.seed_everything(cfg.seed)
-
-    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
-    logger = get_logger(fabric, cfg, log_dir)
-    if fabric.is_global_zero:
-        save_configs(cfg, log_dir)
-
-    num_envs = cfg.env.num_envs
-    envs = vectorize(
-        cfg,
-        [
-            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-    )
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
-    normalize_obs_keys(cfg, obs_space)
-    actions_dim, is_continuous = spaces_to_dims(act_space)
-    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
-    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
-    obs_keys = cnn_keys + mlp_keys
-    dist_type = cfg.get("distribution", {}).get("type", "auto")
-
-    state: Dict[str, Any] = {}
-    if cfg.checkpoint.resume_from:
-        state = fabric.load(cfg.checkpoint.resume_from)
-    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
-    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
-    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
-
-    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
-
-    # on-policy loops honor algo.player.device (placement only; the sync
-    # cadence options are meaningless on-policy: rollouts must use the
-    # current weights)
-    host = fabric.player_device(cfg)
+def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type):
+    """The jitted policy/value/train-phase programs shared by the pipelined
+    (single-controller) and dedicated (cross-process) decoupled topologies."""
     reduction = cfg.algo.loss_reduction
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_adv = bool(cfg.algo.normalize_advantages)
@@ -166,6 +129,124 @@ def main(fabric: Any, cfg: Any) -> None:
         (p, o_state), losses = jax.lax.scan(epoch_body, (p, o_state), jax.random.split(k, update_epochs))
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
+    return policy_step_fn, values_fn, train_phase
+
+
+def _run_rollout(ctx, obs, p_params, key, fold_rank=None):
+    """THE env-interaction rollout loop, shared by the pipelined and the
+    dedicated decoupled topologies (one copy of the truncation-bootstrap /
+    episode-stats / buffer-layout logic).  Returns
+    ``(last_obs, numpy_rollout, key, policy_steps_taken)``; callers marshal
+    the numpy stacks to their own device/mesh layout.  ``fold_rank`` keeps
+    per-rank action sampling decorrelated where the base key stream must
+    stay rank-identical (the pipelined multi-process path)."""
+    envs, rb, aggregator = ctx["envs"], ctx["rb"], ctx["aggregator"]
+    policy_step_fn, values_fn = ctx["policy_step_fn"], ctx["values_fn"]
+    obs_keys, cnn_keys, mlp_keys = ctx["obs_keys"], ctx["cnn_keys"], ctx["mlp_keys"]
+    act_space, gamma = ctx["act_space"], ctx["gamma"]
+    steps = 0
+    with jax.default_device(ctx["host"]):
+        for _ in range(ctx["rollout_steps"]):
+            steps += ctx["step_increment"]
+            dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
+            key, sk = jax.random.split(key)
+            if fold_rank is not None:
+                sk = jax.random.fold_in(sk, fold_rank)
+            actions, logprobs, _ = policy_step_fn(p_params, dev_obs, sk)
+            actions_np = np.asarray(actions)
+            next_obs, rewards, terminated, truncated, info = envs.step(
+                actions_for_env(actions_np, act_space)
+            )
+            dones = np.logical_or(terminated, truncated)
+            rewards = np.asarray(rewards, np.float32)
+            if np.any(truncated):
+                # truncation bootstrap: add gamma*V(s_T) to rewards of
+                # truncated envs (reference: sheeprl/algos/ppo/ppo.py:287-306)
+                final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
+                if final_obs is not None:
+                    padded = {kk: np.asarray(next_obs[kk]).copy() for kk in obs_keys}
+                    for kk in obs_keys:
+                        padded[kk][truncated] = final_obs[kk]
+                    vals = np.asarray(values_fn(p_params, prepare_obs(padded, cnn_keys, mlp_keys)))
+                    rewards[truncated] += gamma * vals[truncated]
+            step_data = {}
+            for kk in obs_keys:
+                step_data[kk] = np.asarray(obs[kk])[None]
+            step_data["actions"] = actions_np[None]
+            step_data["logprobs"] = np.asarray(logprobs)[None]
+            step_data["rewards"] = rewards[None]
+            step_data["dones"] = dones[None].astype(np.float32)
+            rb.add({kk: v[..., None] if v.ndim == 2 else v for kk, v in step_data.items()})
+            obs = next_obs
+            for ep_ret, ep_len in episode_stats(info):
+                aggregator.update("Rewards/rew_avg", ep_ret)
+                aggregator.update("Game/ep_len_avg", ep_len)
+    local = rb.buffer
+    rollout = {kk: np.asarray(local[kk]) for kk in obs_keys}
+    rollout["actions"] = np.asarray(local["actions"])
+    rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
+    rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+    rollout["dones"] = np.asarray(local["dones"][..., 0])
+    return obs, rollout, key, steps
+
+
+@register_algorithm(decoupled=True, name="ppo_decoupled")
+def main(fabric: Any, cfg: Any) -> None:
+    dedicated = (cfg.algo.get("player", {}) or {}).get("dedicated", False)
+    if dedicated and fabric.num_processes > 1:
+        return _dedicated_main(fabric, cfg)
+    if dedicated:
+        import warnings
+
+        warnings.warn(
+            "algo.player.dedicated=True needs >= 2 processes (jax.distributed); "
+            "falling back to the single-controller pipelined topology",
+            UserWarning,
+        )
+    rank = fabric.global_rank
+    key = fabric.seed_everything(cfg.seed)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if fabric.is_global_zero:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = vectorize(
+        cfg,
+        [
+            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+            for i in range(num_envs)
+        ],
+    )
+    obs_space = envs.single_observation_space
+    act_space = envs.single_action_space
+    normalize_obs_keys(cfg, obs_space)
+    actions_dim, is_continuous = spaces_to_dims(act_space)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+    agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
+    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    # on-policy loops honor algo.player.device (placement only; the sync
+    # cadence options are meaningless on-policy: rollouts must use the
+    # current weights)
+    host = fabric.player_device(cfg)
+    gamma = float(cfg.algo.gamma)
+    policy_step_fn, values_fn, train_phase = _build_train_fns(
+        agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type
+    )
+
     rollout_steps = int(cfg.algo.rollout_steps)
     # GLOBAL env-step accounting: every process steps its own envs
     policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
@@ -181,55 +262,30 @@ def main(fabric: Any, cfg: Any) -> None:
 
     rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=obs_keys)
 
+    rollout_ctx = {
+        "envs": envs, "rb": rb, "aggregator": aggregator, "host": host,
+        "policy_step_fn": policy_step_fn, "values_fn": values_fn,
+        "obs_keys": obs_keys, "cnn_keys": cnn_keys, "mlp_keys": mlp_keys,
+        "act_space": act_space, "gamma": gamma,
+        "rollout_steps": rollout_steps,
+        # GLOBAL env-step accounting: every process steps its own envs
+        "step_increment": num_envs * fabric.num_processes,
+    }
+
     def collect_rollout(obs, player_params, key):
-        """One rollout with the (possibly stale) player params."""
+        """One rollout with the (possibly stale) player params; per-rank
+        sampling folds the rank into the player key only (the shared key
+        stream must stay rank-identical for the train dispatch)."""
         nonlocal policy_step
-        with jax.default_device(host):
-            for _ in range(rollout_steps):
-                policy_step += num_envs * fabric.num_processes
-                dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-                key, sk = jax.random.split(key)
-                # per-rank sampling: the shared key stream stays rank-identical
-                # (train-dispatch keys must agree across processes), so fold the
-                # rank into the PLAYER key only
-                sk = jax.random.fold_in(sk, rank)
-                actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
-                actions_np = np.asarray(actions)
-                next_obs, rewards, terminated, truncated, info = envs.step(
-                    actions_for_env(actions_np, act_space)
-                )
-                dones = np.logical_or(terminated, truncated)
-                rewards = np.asarray(rewards, np.float32)
-                if np.any(truncated):
-                    final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
-                    if final_obs is not None:
-                        padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-                        for k in obs_keys:
-                            padded[k][truncated] = final_obs[k]
-                        vals = np.asarray(values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys)))
-                        rewards[truncated] += gamma * vals[truncated]
-                step_data = {}
-                for k in obs_keys:
-                    step_data[k] = np.asarray(obs[k])[None]
-                step_data["actions"] = actions_np[None]
-                step_data["logprobs"] = np.asarray(logprobs)[None]
-                step_data["rewards"] = rewards[None]
-                step_data["dones"] = dones[None].astype(np.float32)
-                rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
-                obs = next_obs
-                for ep_ret, ep_len in episode_stats(info):
-                    aggregator.update("Rewards/rew_avg", ep_ret)
-                    aggregator.update("Game/ep_len_avg", ep_len)
+        obs, rollout_np, key, steps = _run_rollout(rollout_ctx, obs, player_params, key, fold_rank=rank)
+        policy_step += steps
         from sheeprl_tpu.algos.ppo.ppo import _obs_to_device
 
-        local = rb.buffer
         rollout = {}
         for k in obs_keys:
-            rollout[k] = _obs_to_device(local[k], k in cnn_keys)
-        rollout["actions"] = jnp.asarray(local["actions"])
-        rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
-        rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
-        rollout["dones"] = jnp.asarray(local["dones"][..., 0])
+            rollout[k] = _obs_to_device(rollout_np[k], k in cnn_keys)
+        for k in ("actions", "logprobs", "rewards", "dones"):
+            rollout[k] = jnp.asarray(rollout_np[k])
         return obs, rollout, key
 
     # the train phase is a GLOBAL program: its batch covers all ranks
@@ -293,18 +349,7 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/policy_loss", pg)
                 aggregator.update("Loss/value_loss", vl)
                 aggregator.update("Loss/entropy_loss", ent)
-            metrics = aggregator.compute()
-            aggregator.reset()
-            times = timer.to_dict(reset=True)
-            steps_since = max(policy_step - last_log, 1)
-            if "Time/env_interaction_time" in times:
-                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
-            if "Time/train_time" in times:
-                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
-            metrics.update(times)
-            if logger is not None and metrics:
-                logger.log_metrics(metrics, policy_step)
-            last_log = policy_step
+            last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
 
         if (
             cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
@@ -327,5 +372,269 @@ def main(fabric: Any, cfg: Any) -> None:
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(agent, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()
+
+
+def _dedicated_main(fabric: Any, cfg: Any) -> None:
+    """Cross-process player/trainer split (``algo.player.dedicated=True``,
+    requires >= 2 processes).
+
+    Process topology, matching the reference's decoupled PPO
+    (reference: sheeprl/algos/ppo/ppo_decoupled.py:32-365 player,
+    :368-620 trainer, :623-670 group setup):
+
+    * process 0 — the PLAYER: owns the envs, acts with a host-device policy
+      copy, never joins the train mesh;
+    * processes 1..N-1 — TRAINERS: own a sub-mesh over their devices (the
+      reference's trainer-only DDP ``optimization_pg``) and run the jitted
+      train phase, gradients all-reduced by GSPMD over the sub-mesh.
+
+    Per-iteration protocol (reference's scatter/broadcast collectives →
+    host object collectives over DCN):
+
+    1. player broadcasts rollout *k* (+ final obs) to everyone  [src=0];
+    2. trainers dispatch the train phase on rollout *k* while the player
+       collects rollout *k+1* on weights from iteration *k-1* — the
+       cross-process overlap the reference gets from its process split;
+    3. the first trainer broadcasts refreshed weights (+losses, + full
+       train state on checkpoint cadence) [src=1]; the player refreshes
+       its policy and logs/saves.
+    """
+    rank = fabric.global_rank
+    is_player = rank == 0
+    key = fabric.seed_everything(cfg.seed)
+    if is_player:
+        # fork the player's key stream off the trainers' (the coupled path's
+        # fold_in(rank) separation): without this, the player's action keys
+        # at step i would exactly equal the trainers' train-phase keys
+        key = jax.random.fold_in(key, 0x9E37)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if is_player:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = None
+    if is_player:
+        envs = vectorize(
+            cfg,
+            [
+                make_env(cfg, cfg.seed + i, 0, run_name=log_dir, vector_env_idx=i)
+                for i in range(num_envs)
+            ],
+        )
+        spaces = (envs.single_observation_space, envs.single_action_space)
+    else:
+        spaces = None
+    # trainers never build envs; they learn the spaces from the player
+    obs_space, act_space = fabric.broadcast_object(spaces, src=0)
+    normalize_obs_keys(cfg, obs_space)
+    actions_dim, is_continuous = spaces_to_dims(act_space)
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_keys = cnn_keys + mlp_keys
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
+    gamma = float(cfg.algo.gamma)
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        state = fabric.load(cfg.checkpoint.resume_from)
+
+    from sheeprl_tpu.parallel.fabric import get_trainer_fabric
+
+    optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
+    # honor algo.player.device (host by default; 'accelerator' = the player
+    # process's own otherwise-idle chip, for big pixel encoders)
+    host = fabric.player_device(cfg)
+    if is_player:
+        # player-only agent: params live on the player device, no mesh involved
+        from sheeprl_tpu.parallel.fabric import get_single_device_fabric
+
+        player_fabric = get_single_device_fabric(fabric, device=host)
+        agent, params = build_agent(
+            player_fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
+        )
+        player_params = fabric.copy_to(params, host)
+        trainer_fabric = None
+    else:
+        trainer_fabric = get_trainer_fabric(fabric, player_process=0)
+        agent, params = build_agent(
+            trainer_fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
+        )
+        opt_state = trainer_fabric.replicate(state.get("opt_state") or optimizer.init(params))
+
+    policy_step_fn, values_fn, train_phase = _build_train_fns(
+        agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type
+    )
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    rollout_steps = int(cfg.algo.rollout_steps)
+    policy_steps_per_iter = num_envs * rollout_steps  # only the player steps envs
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    clip_coef_v = float(cfg.algo.clip_coef)
+    ent_coef_v = float(cfg.algo.ent_coef)
+
+    # deterministic on every process: both sides agree when a checkpoint is
+    # due without an extra message.  The player's own policy_step counter
+    # runs one rollout AHEAD of the trainers' (it collects k+1 before sync
+    # B of iteration k), so cadence uses the canonical per-iteration step.
+    base_step = policy_step
+
+    def canonical_step(update: int) -> int:
+        return base_step + (update - start_iter + 1) * policy_steps_per_iter
+
+    def ckpt_due(step: int, update: int) -> bool:
+        return (
+            cfg.checkpoint.every > 0 and step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last)
+
+    # ---------------- player-side rollout ------------------------------------
+    rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=obs_keys) if is_player else None
+
+    if is_player:
+        rollout_ctx = {
+            "envs": envs, "rb": rb, "aggregator": aggregator, "host": host,
+            "policy_step_fn": policy_step_fn, "values_fn": values_fn,
+            "obs_keys": obs_keys, "cnn_keys": cnn_keys, "mlp_keys": mlp_keys,
+            "act_space": act_space, "gamma": gamma,
+            "rollout_steps": rollout_steps,
+            "step_increment": num_envs,  # only the player steps envs
+        }
+
+    def collect_rollout(obs, p_params, k):
+        """One rollout; returns raw numpy stacks (shipped over DCN).  The
+        player's key stream is already forked off the trainers' (fold_in at
+        seed time), so no per-step rank folding is needed."""
+        nonlocal policy_step
+        obs, rollout_np, k, steps = _run_rollout(rollout_ctx, obs, p_params, k)
+        policy_step += steps
+        return obs, rollout_np, k
+
+    # ---------------- trainer-side batch assembly ----------------------------
+    if not is_player:
+        from sheeprl_tpu.parallel.fabric import host_tree_to_mesh
+
+        tmesh = trainer_fabric.mesh
+        t_world = trainer_fabric.world_size
+        shard_envs = num_envs % t_world == 0
+        global_bs = min(int(cfg.algo.per_rank_batch_size) * t_world, rollout_steps * num_envs)
+        num_minibatches = -(-rollout_steps * num_envs // global_bs)
+
+        def to_mesh(tree, axis=1):
+            return host_tree_to_mesh(tree, tmesh, axis=axis, shard=shard_envs)
+
+        def device_rollout(rollout_np):
+            # numpy-side normalize/layout (NO accelerator round-trip: the
+            # mesh landing below is the single upload)
+            out = {}
+            for kk in obs_keys:
+                out[kk] = obs_to_np(rollout_np[kk], kk in cnn_keys, rollout=True)
+            for kk in ("actions", "logprobs", "rewards", "dones"):
+                out[kk] = np.asarray(rollout_np[kk], np.float32)
+            return to_mesh(out, axis=1)
+
+    # ---------------- lockstep protocol --------------------------------------
+    acc_train_times: Dict[str, float] = {}
+    obs = None
+    if is_player:
+        obs, _ = envs.reset(seed=cfg.seed)
+        with timer("Time/env_interaction_time"):
+            obs, rollout_np, key = collect_rollout(obs, player_params, key)
+    else:
+        rollout_np = None
+
+    for update in range(start_iter, total_iters + 1):
+        if is_player:
+            payload = (rollout_np, {kk: np.asarray(obs[kk]) for kk in obs_keys})
+        else:
+            payload = None
+        rollout_np, last_obs_np = fabric.broadcast_object(payload, src=0)  # sync A
+        if not is_player:
+            policy_step += policy_steps_per_iter
+            with timer("Time/train_time"):
+                key, tk = jax.random.split(key)
+                params, opt_state, losses = train_phase(
+                    params, opt_state, device_rollout(rollout_np),
+                    to_mesh({kk: obs_to_np(last_obs_np[kk], kk in cnn_keys) for kk in obs_keys}, axis=0),
+                    tk, jnp.float32(clip_coef_v), jnp.float32(ent_coef_v),
+                    batch_size=global_bs, num_minibatches=num_minibatches,
+                )
+        elif update < total_iters:
+            # overlap: the player collects rollout k+1 (stale weights) while
+            # the trainers crunch rollout k
+            with timer("Time/env_interaction_time"):
+                obs, rollout_np, key = collect_rollout(obs, player_params, key)
+
+        # sync B: refreshed weights (+ state on checkpoint cadence) → player
+        due = ckpt_due(canonical_step(update), update)
+        if rank == 1:
+            from sheeprl_tpu.parallel.fabric import fetch_local
+
+            host_params = fetch_local(params)
+            host_losses = tuple(float(x) for x in fetch_local(losses))
+            extra = fetch_local(opt_state) if due else None
+            back = (host_params, host_losses, extra, timer.to_dict(reset=True))
+        else:
+            back = None
+        host_params, host_losses, opt_for_ckpt, train_times = fabric.broadcast_object(back, src=1)
+        for tk_, tv_ in (train_times or {}).items():
+            acc_train_times[tk_] = acc_train_times.get(tk_, 0.0) + tv_
+
+        # schedules march in lockstep on every process
+        if cfg.algo.anneal_lr and not is_player:
+            opt_state = set_learning_rate(
+                opt_state,
+                polynomial_decay(update, initial=float(cfg.algo.optimizer.lr), final=0.0, max_decay_steps=total_iters),
+            )
+        if cfg.algo.anneal_clip_coef:
+            clip_coef_v = polynomial_decay(update, initial=float(cfg.algo.clip_coef), final=0.0, max_decay_steps=total_iters)
+        if cfg.algo.anneal_ent_coef:
+            ent_coef_v = polynomial_decay(update, initial=float(cfg.algo.ent_coef), final=0.0, max_decay_steps=total_iters)
+
+        if is_player:
+            player_params = jax.device_put(host_params, host)
+            if cfg.metric.log_level > 0 and (
+                policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+            ):
+                pg, vl, ent = host_losses
+                aggregator.update("Loss/policy_loss", pg)
+                aggregator.update("Loss/value_loss", vl)
+                aggregator.update("Loss/entropy_loss", ent)
+                last_log = flush_metrics(
+                    aggregator, timer, logger, policy_step, last_log,
+                    extra_times=dict(acc_train_times),
+                )
+                acc_train_times.clear()
+        if due:
+            # every process calls the hook: fabric.save writes on the player
+            # (global zero) and barriers everyone; keep_last pruning applies
+            last_checkpoint = canonical_step(update)
+            fabric.call(
+                "on_checkpoint_player",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{last_checkpoint}_0.ckpt"),
+                state={
+                    "agent": host_params,
+                    "opt_state": opt_for_ckpt,
+                    "update": update,
+                    "policy_step": last_checkpoint,
+                    "last_log": last_log,
+                    "last_checkpoint": last_checkpoint,
+                },
+            )
+
+    if is_player:
+        envs.close()
+        if cfg.algo.run_test:
+            test(agent, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

@@ -32,14 +32,30 @@ def prepare_obs(
     """
     out: Dict[str, jax.Array] = {}
     for k in cnn_keys:
-        x = np.asarray(obs[k])
-        if x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
+        out[k] = jnp.asarray(obs_to_np(obs[k], is_image=True))
+    for k in mlp_keys:
+        out[k] = jnp.asarray(obs_to_np(obs[k], is_image=False))
+    return out
+
+
+def obs_to_np(x: np.ndarray, is_image: bool, rollout: bool = False) -> np.ndarray:
+    """Numpy-side obs normalization/layout — THE single copy of the
+    frame-stack-merge + ``/255`` rule (:func:`prepare_obs` and the train
+    paths delegate here).  ``rollout`` disambiguates the 5-D case: a rollout
+    image batch is ``(T, B, H, W, C)`` (+stack dim → 6-D), a per-step batch
+    is ``(B, H, W, C)`` (+stack dim → 5-D) — without the flag a non-stacked
+    rollout would be garbled as a stacked step batch."""
+    x = np.asarray(x)
+    if is_image:
+        if rollout:
+            if x.ndim == 6:  # (T, B, S, H, W, C) frame stack → channels
+                t, b, s, h, w, c = x.shape
+                x = np.transpose(x, (0, 1, 3, 4, 2, 5)).reshape(t, b, h, w, s * c)
+        elif x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
             b, s, h, w, c = x.shape
             x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
-        out[k] = jnp.asarray(x, jnp.float32) / 255.0
-    for k in mlp_keys:
-        out[k] = jnp.asarray(np.asarray(obs[k]), jnp.float32)
-    return out
+        return np.asarray(x, np.float32) / 255.0
+    return np.asarray(x, np.float32)
 
 
 def actions_for_env(actions: np.ndarray, action_space: gym.Space) -> np.ndarray:

@@ -37,7 +37,7 @@ from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
-from sheeprl_tpu.utils.metric import MetricAggregator
+from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
@@ -52,6 +52,87 @@ def main(fabric: Any, cfg: Any) -> None:
         return critic.apply(cp, o, a)
 
     sac_loop(fabric, cfg, sac_build_agent, plain_apply)
+
+
+def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha_opt, cfg, act_dim):
+    """The jitted SAC programs (act + scanned multi-update train phase),
+    shared by the coupled loop, DroQ, and the dedicated cross-process
+    decoupled topology (reference: the train() shared between
+    sheeprl/algos/sac/sac.py:30-79 and sac_decoupled.py's trainer)."""
+    gamma = float(cfg.algo.gamma)
+    tau = float(cfg.algo.tau)
+    target_entropy = -float(act_dim)
+    target_freq = int(cfg.algo.critic.target_network_frequency)
+
+    @partial(jax.jit, static_argnames=("greedy",))
+    def act_fn(p, obs, k, greedy=False):
+        a, _ = sample_action(actor, p, obs, k, greedy=greedy)
+        return a
+
+    def one_update(carry, batch_and_key):
+        p, o_state, step_idx = carry
+        batch, k = batch_and_key
+        k_next, k_pi, k_d1, k_d2, k_d3 = jax.random.split(k, 5)
+        alpha = jnp.exp(p["log_alpha"])
+
+        # -- critic
+        next_a, next_lp = sample_action(actor, p["actor"], batch["next_obs"], k_next)
+        target_qs = critic_apply(critic, p["target_critic"], batch["next_obs"], next_a, k_d1)
+        target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
+        # bootstrap THROUGH time-limit truncation: only true termination cuts
+        # the return (reference: sac.py:46 uses data["terminated"])
+        y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * target_v
+
+        def c_loss(cp):
+            qs = critic_apply(critic, cp, batch["obs"], batch["actions"], k_d2)
+            return critic_loss(qs, jax.lax.stop_gradient(y))
+
+        vl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
+        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
+        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
+
+        # -- actor
+        def a_loss(ap):
+            a, lp = sample_action(actor, ap, batch["obs"], k_pi)
+            qs = critic_apply(critic, p["critic"], batch["obs"], a, k_d3)
+            return actor_loss(alpha, lp, jnp.min(qs, axis=0)), lp
+
+        (pl, lp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
+        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
+        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
+
+        # -- temperature
+        def t_loss(la):
+            return alpha_loss(la, lp, target_entropy)
+
+        al, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"])
+        t_updates, new_t_opt = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
+        p = {**p, "log_alpha": p["log_alpha"] + t_updates}
+
+        # -- EMA target (every target_network_frequency updates,
+        #    reference: sac.py target update cadence)
+        do_ema = (step_idx % target_freq) == 0
+        new_target = ema_update(p["target_critic"], p["critic"], tau)
+        p = {
+            **p,
+            "target_critic": jax.tree.map(
+                lambda n, o: jnp.where(do_ema, n, o), new_target, p["target_critic"]
+            ),
+        }
+        o_state = {"actor": new_a_opt, "critic": new_c_opt, "alpha": new_t_opt}
+        return (p, o_state, step_idx + 1), (vl, pl, al)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def train_phase(p, o_state, batches, k, step0):
+        """``batches``: dict of (U, batch, ...) stacked update blocks."""
+        U = batches["rewards"].shape[0]
+        keys = jax.random.split(k, U)
+        (p, o_state, _), losses = jax.lax.scan(
+            one_update, (p, o_state, step0), (batches, keys)
+        )
+        return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
+
+    return act_fn, train_phase
 
 
 def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> None:
@@ -115,81 +196,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
 
     psync = PlayerSync(fabric, cfg, extract=lambda p: p["actor"])
     host = psync.device  # single resolution of algo.player.device
-    gamma = float(cfg.algo.gamma)
-    tau = float(cfg.algo.tau)
-    target_entropy = -float(act_dim)
-    target_freq = int(cfg.algo.critic.target_network_frequency)
-
-    @partial(jax.jit, static_argnames=("greedy",))
-    def act_fn(p, obs, k, greedy=False):
-        a, _ = sample_action(actor, p, obs, k, greedy=greedy)
-        return a
-
+    act_fn, train_phase = make_sac_train_fns(
+        actor, critic, critic_apply, actor_opt, critic_opt, alpha_opt, cfg, act_dim
+    )
     player_params = psync.init(params)
-
-    # ---------------- single-dispatch multi-update train phase --------------
-    def one_update(carry, batch_and_key):
-        p, o_state, step_idx = carry
-        batch, k = batch_and_key
-        k_next, k_pi, k_d1, k_d2, k_d3 = jax.random.split(k, 5)
-        alpha = jnp.exp(p["log_alpha"])
-
-        # -- critic
-        next_a, next_lp = sample_action(actor, p["actor"], batch["next_obs"], k_next)
-        target_qs = critic_apply(critic, p["target_critic"], batch["next_obs"], next_a, k_d1)
-        target_v = jnp.min(target_qs, axis=0) - alpha * next_lp
-        # bootstrap THROUGH time-limit truncation: only true termination cuts
-        # the return (reference: sac.py:46 uses data["terminated"])
-        y = batch["rewards"] + gamma * (1.0 - batch["terminated"]) * target_v
-
-        def c_loss(cp):
-            qs = critic_apply(critic, cp, batch["obs"], batch["actions"], k_d2)
-            return critic_loss(qs, jax.lax.stop_gradient(y))
-
-        vl, c_grads = jax.value_and_grad(c_loss)(p["critic"])
-        c_updates, new_c_opt = critic_opt.update(c_grads, o_state["critic"], p["critic"])
-        p = {**p, "critic": optax.apply_updates(p["critic"], c_updates)}
-
-        # -- actor
-        def a_loss(ap):
-            a, lp = sample_action(actor, ap, batch["obs"], k_pi)
-            qs = critic_apply(critic, p["critic"], batch["obs"], a, k_d3)
-            return actor_loss(alpha, lp, jnp.min(qs, axis=0)), lp
-
-        (pl, lp), a_grads = jax.value_and_grad(a_loss, has_aux=True)(p["actor"])
-        a_updates, new_a_opt = actor_opt.update(a_grads, o_state["actor"], p["actor"])
-        p = {**p, "actor": optax.apply_updates(p["actor"], a_updates)}
-
-        # -- temperature
-        def t_loss(la):
-            return alpha_loss(la, lp, target_entropy)
-
-        al, t_grads = jax.value_and_grad(t_loss)(p["log_alpha"])
-        t_updates, new_t_opt = alpha_opt.update(t_grads, o_state["alpha"], p["log_alpha"])
-        p = {**p, "log_alpha": p["log_alpha"] + t_updates}
-
-        # -- EMA target (every target_network_frequency updates,
-        #    reference: sac.py target update cadence)
-        do_ema = (step_idx % target_freq) == 0
-        new_target = ema_update(p["target_critic"], p["critic"], tau)
-        p = {
-            **p,
-            "target_critic": jax.tree.map(
-                lambda n, o: jnp.where(do_ema, n, o), new_target, p["target_critic"]
-            ),
-        }
-        o_state = {"actor": new_a_opt, "critic": new_c_opt, "alpha": new_t_opt}
-        return (p, o_state, step_idx + 1), (vl, pl, al)
-
-    @partial(jax.jit, donate_argnums=(0, 1))
-    def train_phase(p, o_state, batches, k, step0):
-        """``batches``: dict of (U, batch, ...) stacked update blocks."""
-        U = batches["rewards"].shape[0]
-        keys = jax.random.split(k, U)
-        (p, o_state, _), losses = jax.lax.scan(
-            one_update, (p, o_state, step0), (batches, keys)
-        )
-        return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 
     # ---------------- counters ----------------------------------------------
     # GLOBAL env-step accounting: every process steps its own envs
@@ -313,19 +323,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 aggregator.update("Loss/value_loss", vl)
                 aggregator.update("Loss/policy_loss", pl)
                 aggregator.update("Loss/alpha_loss", al)
-            metrics = aggregator.compute()
-            aggregator.reset()
-            times = timer.to_dict(reset=True)
-            steps_since = max(policy_step - last_log, 1)
-            if "Time/env_interaction_time" in times:
-                metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
-            if "Time/train_time" in times:
-                metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
-            metrics["Params/replay_ratio"] = grad_step_counter * fabric.world_size / max(policy_step, 1)
-            metrics.update(times)
-            if logger is not None and metrics:
-                logger.log_metrics(metrics, policy_step)
-            last_log = policy_step
+            last_log = flush_metrics(
+                aggregator, timer, logger, policy_step, last_log,
+                extra_metrics={"Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1)},
+            )
 
         # ---------------- checkpoint ----------------------------------------
         if (

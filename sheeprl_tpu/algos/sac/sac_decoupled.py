@@ -2,21 +2,42 @@
 (reference: sheeprl/algos/sac/sac_decoupled.py:32-588).
 
 The reference splits rank-0 player from trainer ranks with TorchCollective
-scatter/broadcast.  Single-controller equivalent: train dispatches are
-asynchronous (the host never blocks on them), and the player's params
-refresh only every ``algo.player.sync_every`` windows (10 in this
-experiment's config) — the player interacts on stale weights while the
-device trains, exactly the reference's player↔trainer weight-refresh
-cadence without any process groups.
+scatter/broadcast.  Two TPU-native realizations:
+
+* single/multi-process pipelined (default): train dispatches are
+  asynchronous (the host never blocks on them), and the player's params
+  refresh only every ``algo.player.sync_every`` windows (10 in this
+  experiment's config) — the player interacts on stale weights while the
+  device trains, exactly the reference's player↔trainer weight-refresh
+  cadence without any process groups.
+* ``algo.player.dedicated=True`` with >= 2 processes: a REAL cross-process
+  split — process 0 owns envs + replay buffer and samples the gradient
+  blocks (the reference's player, sac_decoupled.py:250-280), processes
+  1..N-1 train over a trainer sub-mesh; blocks travel player→trainers and
+  actor weights travel back over host object collectives (DCN).
 """
 
 from __future__ import annotations
 
-from typing import Any
+import os
+from typing import Any, Dict
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
 
 from sheeprl_tpu.algos.sac.agent import build_agent
-from sheeprl_tpu.algos.sac.sac import sac_loop
+from sheeprl_tpu.algos.sac.sac import make_sac_train_fns, sac_loop
+from sheeprl_tpu.algos.sac.utils import prepare_obs, test
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
+from sheeprl_tpu.utils.logger import get_log_dir, get_logger
+from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
+from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.timer import timer
+from sheeprl_tpu.utils.utils import Ratio, save_configs
 
 
 @register_algorithm(decoupled=True, name="sac_decoupled")
@@ -24,4 +45,319 @@ def main(fabric: Any, cfg: Any) -> None:
     def plain_apply(critic, cp, o, a, k):
         return critic.apply(cp, o, a)
 
+    dedicated = (cfg.algo.get("player", {}) or {}).get("dedicated", False)
+    if dedicated and fabric.num_processes > 1:
+        return _dedicated_main(fabric, cfg, plain_apply)
+    if dedicated:
+        import warnings
+
+        warnings.warn(
+            "algo.player.dedicated=True needs >= 2 processes (jax.distributed); "
+            "falling back to the single-controller pipelined topology",
+            UserWarning,
+        )
     sac_loop(fabric, cfg, build_agent, plain_apply)
+
+
+def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
+    """Cross-process player/trainer SAC (reference:
+    sheeprl/algos/sac/sac_decoupled.py — player :32-345, trainer :348-545).
+
+    Lockstep protocol: both sides run the same deterministic iteration
+    skeleton (policy-step counters, ``Ratio`` schedule, checkpoint cadence)
+    so they agree on WHEN a gradient block is broadcast [sync A], when
+    refreshed actor weights come back [sync B, every
+    ``algo.player.sync_every`` training windows, one window stale — the
+    reference's refresh cadence], and when a full-state checkpoint
+    rendezvous happens [sync C], without any control messages.
+    """
+    rank = fabric.global_rank
+    is_player = rank == 0
+    key = fabric.seed_everything(cfg.seed)
+    if is_player:
+        # fork the player's key stream off the trainers' (the coupled path's
+        # fold_in(rank) separation)
+        key = jax.random.fold_in(key, 0x9E37)
+
+    log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+    logger = get_logger(fabric, cfg, log_dir)
+    if is_player:
+        save_configs(cfg, log_dir)
+
+    num_envs = cfg.env.num_envs
+    envs = None
+    if is_player:
+        envs = vectorize(
+            cfg,
+            [
+                make_env(cfg, cfg.seed + i, 0, run_name=log_dir, vector_env_idx=i)
+                for i in range(num_envs)
+            ],
+        )
+        spaces = (envs.single_observation_space, envs.single_action_space)
+    else:
+        spaces = None
+    obs_space, act_space = fabric.broadcast_object(spaces, src=0)
+    if not isinstance(act_space, gym.spaces.Box):
+        raise ValueError("SAC supports continuous (Box) action spaces only, like the reference")
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    for k in mlp_keys:
+        if k not in obs_space.spaces:
+            raise ValueError(f"mlp key '{k}' not in observation space {list(obs_space.spaces)}")
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(act_space.shape))
+    act_low = np.asarray(act_space.low, np.float32)
+    act_high = np.asarray(act_space.high, np.float32)
+
+    def to_env_actions(a: np.ndarray) -> np.ndarray:
+        return act_low + (a + 1.0) * 0.5 * (act_high - act_low)
+
+    state: Dict[str, Any] = {}
+    if cfg.checkpoint.resume_from:
+        # only the player touches the checkpoint file: trainers receive the
+        # state WITHOUT the replay buffer (which can be multi-GB and is
+        # player-only) instead of each transiently unpickling all of it
+        if is_player:
+            state = fabric.load(cfg.checkpoint.resume_from)
+            lean = {k: v for k, v in state.items() if k != "rb"}
+        else:
+            lean = None
+        lean = fabric.broadcast_object(lean, src=0)
+        if not is_player:
+            state = lean
+
+    from sheeprl_tpu.parallel.fabric import (
+        get_single_device_fabric,
+        get_trainer_fabric,
+        trainer_device_count,
+    )
+
+    # honor algo.player.device (host by default; 'accelerator' = the player
+    # process's own otherwise-idle chip, for big pixel encoders)
+    host = fabric.player_device(cfg)
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer)
+    alpha_opt = build_optimizer(cfg.algo.alpha.optimizer)
+
+    if is_player:
+        player_fabric = get_single_device_fabric(fabric, device=host)
+        actor, critic, params = build_agent(player_fabric, act_dim, cfg, obs_dim, state.get("agent"))
+        player_params = fabric.copy_to(params["actor"], host)
+        trainer_fabric = None
+        t_world = trainer_device_count(fabric, player_process=0)
+    else:
+        trainer_fabric = get_trainer_fabric(fabric, player_process=0)
+        t_world = trainer_fabric.world_size
+        actor, critic, params = build_agent(trainer_fabric, act_dim, cfg, obs_dim, state.get("agent"))
+        opt_state = trainer_fabric.replicate(
+            state.get("opt_state")
+            or {
+                "actor": actor_opt.init(params["actor"]),
+                "critic": critic_opt.init(params["critic"]),
+                "alpha": alpha_opt.init(params["log_alpha"]),
+            }
+        )
+
+    act_fn, train_phase = make_sac_train_fns(
+        actor, critic, critic_apply, actor_opt, critic_opt, alpha_opt, cfg, act_dim
+    )
+
+    aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
+    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+
+    # ---------------- deterministic lockstep counters ------------------------
+    policy_steps_per_iter = num_envs  # only the player steps envs
+    total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
+    if cfg.dry_run:
+        total_iters = 1
+    learning_starts = int(cfg.algo.learning_starts) // policy_steps_per_iter if not cfg.dry_run else 0
+    start_iter = int(state.get("update", 0)) + 1 if state else 1
+    policy_step = int(state.get("policy_step", 0))
+    last_log = int(state.get("last_log", 0))
+    last_checkpoint = int(state.get("last_checkpoint", 0))
+    grad_step_counter = int(state.get("grad_steps", 0))
+    if state:
+        learning_starts += start_iter
+    sync_every = max(1, int((cfg.algo.get("player", {}) or {}).get("sync_every", 1)))
+    windows = int(state.get("windows", 0))
+
+    ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
+    if state and "ratio" in state:
+        ratio.load_state_dict(state["ratio"])
+
+    batch_size = int(cfg.algo.per_rank_batch_size) * max(t_world, 1)
+
+    rb = None
+    if is_player:
+        rb = ReplayBuffer(
+            int(cfg.buffer.size) // num_envs,
+            num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", "rank_0") if cfg.buffer.memmap else None,
+        )
+        if state and cfg.buffer.checkpoint and "rb" in state:
+            rb.load_state_dict(state["rb"])
+
+    # ---------------- trainer-side batch assembly ----------------------------
+    if not is_player:
+        from sheeprl_tpu.parallel.fabric import host_tree_to_mesh
+
+        tmesh = trainer_fabric.mesh
+
+        def to_mesh(tree, axis=1):
+            # batch_size = per_rank_batch_size * t_world by construction, so
+            # the batch axis always divides the trainer mesh
+            return host_tree_to_mesh(tree, tmesh, axis=axis, shard=True)
+
+    from sheeprl_tpu.parallel.fabric import fetch_local as fetch
+
+    # ---------------- main loop ----------------------------------------------
+    acc_train_times: Dict[str, float] = {}
+    obs_vec = None
+    if is_player:
+        obs, _ = envs.reset(seed=cfg.seed)
+        obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
+    last_losses = None
+
+    for update in range(start_iter, total_iters + 1):
+        policy_step += policy_steps_per_iter
+
+        if is_player:
+            with timer("Time/env_interaction_time"):
+                if update <= learning_starts and not state:
+                    env_actions = np.stack([act_space.sample() for _ in range(num_envs)])
+                    span = act_high - act_low
+                    actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
+                else:
+                    with jax.default_device(host):
+                        key, sk = jax.random.split(key)
+                        actions = np.asarray(act_fn(player_params, jnp.asarray(obs_vec), sk))
+                    env_actions = to_env_actions(actions)
+                next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
+                dones = np.logical_or(terminated, truncated).astype(np.float32)
+                rewards = np.asarray(rewards, np.float32)
+                next_vec = np.asarray(prepare_obs(next_obs, mlp_keys))
+                store_next = next_vec
+                done_idx = np.nonzero(dones)[0]
+                if done_idx.size:
+                    final = final_obs_rows(info, done_idx, mlp_keys)
+                    if final is not None:
+                        store_next = next_vec.copy()
+                        store_next[done_idx] = np.concatenate(
+                            [np.asarray(final[k], np.float32).reshape(done_idx.size, -1) for k in mlp_keys],
+                            axis=-1,
+                        )
+                rb.add(
+                    {
+                        "obs": obs_vec[None],
+                        "next_obs": store_next[None],
+                        "actions": actions[None].astype(np.float32),
+                        "rewards": rewards[None, :, None],
+                        "terminated": terminated.astype(np.float32)[None, :, None],
+                    }
+                )
+                obs_vec = next_vec
+                for ep_ret, ep_len in episode_stats(info):
+                    aggregator.update("Rewards/rew_avg", ep_ret)
+                    aggregator.update("Game/ep_len_avg", ep_len)
+        # ---------------- training windows (lockstep) ------------------------
+        if update >= learning_starts:
+            gradient_steps = ratio(policy_step / max(t_world, 1))
+            if gradient_steps > 0:
+                windows += 1
+                sync_due = windows % sync_every == 0
+                if is_player:
+                    sample = rb.sample(batch_size, n_samples=gradient_steps)
+                    block = {
+                        "obs": np.asarray(sample["obs"], np.float32),
+                        "next_obs": np.asarray(sample["next_obs"], np.float32),
+                        "actions": np.asarray(sample["actions"], np.float32),
+                        "rewards": np.asarray(sample["rewards"][..., 0], np.float32),
+                        "terminated": np.asarray(sample["terminated"][..., 0], np.float32),
+                    }
+                else:
+                    block = None
+                block = fabric.broadcast_object(block, src=0)  # sync A
+                key, tk = jax.random.split(key)
+                back = None
+                if not is_player:
+                    if sync_due and rank == 1:
+                        # PREVIOUS window's (long since finished) weights —
+                        # fetched before this window's dispatch donates them
+                        back = (
+                            fetch(params["actor"]),
+                            fetch(last_losses) if last_losses is not None else None,
+                            timer.to_dict(reset=True),
+                        )
+                    with timer("Time/train_time"):
+                        params, opt_state, last_losses = train_phase(
+                            params, opt_state, to_mesh(block), tk, jnp.int32(grad_step_counter)
+                        )
+                grad_step_counter += gradient_steps
+                if sync_due:
+                    back = fabric.broadcast_object(back, src=1)  # sync B
+                    if is_player:
+                        actor_np, losses_np, t_times = back
+                        player_params = jax.device_put(actor_np, host)
+                        if losses_np is not None:
+                            last_losses = losses_np
+                        for tk_, tv_ in (t_times or {}).items():
+                            acc_train_times[tk_] = acc_train_times.get(tk_, 0.0) + tv_
+
+        # ---------------- logging (player) -----------------------------------
+        if is_player and cfg.metric.log_level > 0 and (
+            policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run
+        ):
+            if last_losses is not None:
+                vl, pl, al = last_losses
+                aggregator.update("Loss/value_loss", float(vl))
+                aggregator.update("Loss/policy_loss", float(pl))
+                aggregator.update("Loss/alpha_loss", float(al))
+            last_log = flush_metrics(
+                aggregator, timer, logger, policy_step, last_log,
+                extra_times=dict(acc_train_times),
+                extra_metrics={"Params/replay_ratio": grad_step_counter * max(t_world, 1) / max(policy_step, 1)},
+            )
+            acc_train_times.clear()
+
+        # ---------------- checkpoint rendezvous [sync C] ----------------------
+        if (
+            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
+        ) or (update == total_iters and cfg.checkpoint.save_last):
+            last_checkpoint = policy_step
+            payload = None
+            if rank == 1:
+                payload = (fetch(params), fetch(opt_state))
+            payload = fabric.broadcast_object(payload, src=1)
+            agent_np, opt_np = payload
+            ckpt_state = {
+                "agent": agent_np,
+                "opt_state": opt_np,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "ratio": ratio.state_dict(),
+                "grad_steps": grad_step_counter,
+                "windows": windows,
+            }
+            # every process calls the hook: fabric.save writes on the player
+            # (global zero, which owns the buffer) and barriers everyone;
+            # keep_last pruning applies
+            fabric.call(
+                "on_checkpoint_player",
+                ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
+                state=ckpt_state,
+                replay_buffer=rb if (is_player and cfg.buffer.checkpoint) else None,
+            )
+
+    # final resync: player_params lag by up to sync_every windows (the
+    # coupled loop's psync.init-before-test, sac.py, does the same job)
+    final_actor = fabric.broadcast_object(fetch(params["actor"]) if rank == 1 else None, src=1)
+    if is_player:
+        player_params = jax.device_put(final_actor, host)
+        envs.close()
+        if cfg.algo.run_test:
+            test(actor, player_params, cfg, log_dir, logger)
+    if logger is not None:
+        logger.close()

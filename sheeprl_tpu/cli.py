@@ -127,16 +127,24 @@ def _maybe_register_models(fabric, cfg: dotdict) -> None:
         glob.glob(os.path.join(root, "version_*")),
         key=lambda p: int(p.rsplit("_", 1)[-1]),
     )
-    for vdir in reversed(versions):
-        ckpts = sorted(
-            glob.glob(os.path.join(vdir, "checkpoint", "*.ckpt")), key=os.path.getmtime
+    if not versions:
+        return
+    # ONLY the newest version dir — the one this run just wrote.  Falling
+    # back to older runs would silently register stale weights when this
+    # run saved no checkpoint (checkpoint.every=0, save_last=False).
+    ckpts = sorted(
+        glob.glob(os.path.join(versions[-1], "checkpoint", "*.ckpt")), key=os.path.getmtime
+    )
+    if not ckpts:
+        warnings.warn(
+            "model_manager.disabled=False but the run saved no checkpoint; "
+            "nothing registered", UserWarning
         )
-        if ckpts:
-            state = load_checkpoint(ckpts[-1])
-            out = register_model_from_checkpoint(fabric, cfg, state)
-            if out:
-                print(f"Registered models from {ckpts[-1]}: {out}")
-            return
+        return
+    state = load_checkpoint(ckpts[-1])
+    out = register_model_from_checkpoint(fabric, cfg, state)
+    if out:
+        print(f"Registered models from {ckpts[-1]}: {out}")
 
 
 def run(argv: Optional[List[str]] = None) -> None:

@@ -174,6 +174,7 @@ def compose(
                 groups.add(g)
     group_selection: Dict[str, Any] = {}
     dot_overrides: List[Tuple[str, Any]] = []
+    placed_groups: List[Tuple[str, str, Any]] = []  # (target path, group, name)
     for ov in overrides:
         if "=" not in ov:
             raise ConfigError(f"Override '{ov}' must look like key=value")
@@ -182,6 +183,13 @@ def compose(
         value = _parse_value(raw.strip())
         if "." not in key and key in groups:
             group_selection[key] = value
+        elif "/" in key and key.rpartition("/")[2] in groups:
+            # "metric/logger=mlflow": swap the group instance PLACED at a
+            # nested path (the defaults-list "@" packaging, e.g.
+            # metric/default.yaml's "/logger@logger: tensorboard") from the
+            # CLI — hydra's `logger@metric.logger=mlflow` equivalent.
+            parent, _, grp = key.rpartition("/")
+            placed_groups.append((f"{parent.replace('/', '.')}.{grp}", grp, value))
         else:
             dot_overrides.append((key, value))
 
@@ -230,6 +238,11 @@ def compose(
     for name in exp_names:
         overlay = _load_yaml_exp(name, dirs, cfg, cli_groups)
         cfg = deep_merge(cfg, overlay)
+
+    for path, grp, name in placed_groups:
+        loaded = _load_group(grp, name, dirs)
+        loaded.pop("__root__", None)
+        set_by_path(cfg, path, loaded)
 
     for key, value in dot_overrides:
         set_by_path(cfg, key, value)
@@ -337,8 +350,16 @@ def _resolve_ref(ref: str, tree: Mapping[str, Any], stack: Tuple[str, ...]) -> A
     if ref.startswith("eval:"):
         inner = _resolve_value(ref[len("eval:"):], tree, stack)
         return _safe_eval(str(inner))
-    if ref.startswith("oc.env:"):  # hydra/omegaconf-compatible alias
-        ref = "env:" + ref[len("oc.env:"):]
+    if ref.startswith("oc.env:"):
+        # hydra/omegaconf-compatible alias — and omegaconf-compatible
+        # STRICTNESS: a missing variable with no default raises instead of
+        # silently resolving to None (``${env:...}`` stays lenient)
+        body = ref[len("oc.env:"):]
+        if "," not in body and body.strip() not in os.environ:
+            raise ConfigError(
+                f"Environment variable '{body.strip()}' (from ${{oc.env:...}}) is not set"
+            )
+        ref = "env:" + body
     if ref.startswith("env:"):
         body = ref[len("env:"):]
         var, _, default = body.partition(",")

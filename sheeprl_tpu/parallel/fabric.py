@@ -318,12 +318,21 @@ class Fabric:
             return obj
         from jax.experimental import multihost_utils
 
-        payload = _pickle_to_u8(obj) if self.global_rank == src else None
+        is_source = self.global_rank == src
+        payload = _pickle_to_u8(obj) if is_source else None
+        # broadcast_one_to_all sources from process 0 unless told otherwise —
+        # src != 0 (e.g. the trainer→player weight refresh of the dedicated
+        # decoupled topology) must pass is_source explicitly
         length = multihost_utils.broadcast_one_to_all(
-            np.asarray([0 if payload is None else payload.size], dtype=np.int64)
+            np.asarray([0 if payload is None else payload.size], dtype=np.int64),
+            is_source=is_source,
         )[0]
         buf = payload if payload is not None else np.zeros(int(length), dtype=np.uint8)
-        out = multihost_utils.broadcast_one_to_all(buf)
+        out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+        if is_source:
+            # skip re-deserializing our own payload (sync-A rollouts are
+            # ~100MB/iteration in the dedicated decoupled topology)
+            return obj
         return _u8_to_obj(np.asarray(out))
 
     def barrier(self) -> None:
@@ -493,16 +502,84 @@ def build_fabric(cfg: Any) -> Fabric:
     return fabric
 
 
-def get_single_device_fabric(fabric: Fabric) -> Fabric:
+def trainer_device_count(fabric: Fabric, player_process: int = 0) -> int:
+    """Number of mesh devices in the trainer group of the dedicated
+    decoupled topology — THE sizing rule both sides of the protocol share
+    (the player can't build the trainer fabric itself but must agree on
+    ``batch_size = per_rank_batch_size * trainer_world``)."""
+    return sum(1 for d in fabric.mesh.devices.flat if d.process_index != player_process)
+
+
+def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
+    """A fabric whose mesh spans only the devices NOT owned by the dedicated
+    player process — the trainer group of the cross-process decoupled
+    topology (reference: the trainer-only ``optimization_pg`` DDP subgroup,
+    sheeprl/algos/ppo/ppo_decoupled.py:645-666).  Programs jitted on this
+    mesh must be launched by every trainer process and by no other."""
+    trainer_devices = [
+        d for d in fabric.mesh.devices.flat if d.process_index != player_process
+    ]
+    if not trainer_devices:
+        raise ValueError(
+            "dedicated-player topology needs at least one device owned by a "
+            "non-player process (got none; run with >= 2 processes)"
+        )
+    sub = Fabric.__new__(Fabric)
+    sub.strategy = fabric.strategy
+    sub.precision = fabric.precision
+    sub.callbacks = fabric.callbacks
+    sub._callback_cfg = fabric._callback_cfg
+    sub.devices = trainer_devices
+    sub.accelerator = fabric.accelerator
+    sub.mesh = Mesh(np.asarray(trainer_devices), ("data",))
+    sub.data_axis = "data"
+    return sub
+
+
+def get_single_device_fabric(fabric: Fabric, device: Optional[Any] = None) -> Fabric:
     """A fabric pinned to one device, for inference-only "player" models
-    (reference: sheeprl/utils/fabric.py:8-35)."""
+    (reference: sheeprl/utils/fabric.py:8-35).  Pass ``device`` to pin to a
+    specific one — e.g. ``fabric.host_device`` for the dedicated player of
+    the cross-process decoupled topology."""
+    device = fabric.device if device is None else device
     single = Fabric.__new__(Fabric)
     single.strategy = fabric.strategy
     single.precision = fabric.precision
     single.callbacks = []
     single._callback_cfg = {}
-    single.devices = [fabric.device]
+    single.devices = [device]
     single.accelerator = fabric.accelerator
-    single.mesh = Mesh(np.asarray([fabric.device]), ("data",))
+    single.mesh = Mesh(np.asarray([device]), ("data",))
     single.data_axis = "data"
     return single
+
+
+def host_tree_to_mesh(tree: Any, mesh: Mesh, axis: int = 0, shard: bool = True) -> Any:
+    """Assemble global device arrays ON a (possibly multi-process) mesh from
+    host numpy values every participating process holds in full — the
+    trainer-side batch landing of the dedicated decoupled topology.  Uses
+    ``jax.make_array_from_callback``: no communication, each process serves
+    its addressable shards.  ``shard=False`` replicates instead (the
+    fallback when the batch axis does not divide the mesh)."""
+
+    def put(x: Any) -> Any:
+        x = np.asarray(x)
+        spec: List[Any] = [None] * x.ndim
+        if shard and x.ndim > axis:
+            spec[axis] = mesh.axis_names[0]
+        sh = NamedSharding(mesh, P(*spec))
+        return jax.make_array_from_callback(x.shape, sh, lambda idx, _x=x: _x[idx])
+
+    return jax.tree.map(put, tree)
+
+
+def fetch_local(tree: Any) -> Any:
+    """Pull a (replicated) device pytree to host numpy via the process-local
+    shard — works on non-fully-addressable multi-process arrays where
+    ``np.asarray`` alone would fail."""
+    return jax.tree.map(
+        lambda x: np.asarray(x.addressable_shards[0].data)
+        if isinstance(x, jax.Array)
+        else np.asarray(x),
+        tree,
+    )

@@ -131,3 +131,37 @@ class RankIndependentMetricAggregator(MetricAggregator):
             for k, v in d.items():
                 merged[k].append(v)
         return {k: float(np.mean(v)) for k, v in merged.items()}
+
+
+def flush_metrics(
+    aggregator: "MetricAggregator",
+    timer_obj: Any,
+    logger: Any,
+    policy_step: int,
+    last_log: int,
+    extra_times: Optional[Dict[str, float]] = None,
+    extra_metrics: Optional[Dict[str, float]] = None,
+) -> int:
+    """THE end-of-interval metric flush every train loop shares: compute+reset
+    the aggregator, drain the named timers, derive the two SPS throughputs
+    (reference: the identical block at e.g. sheeprl/algos/ppo/ppo.py:376-413 /
+    dreamer_v3.py:715-730), merge ``extra_times`` (e.g. trainer-side times
+    shipped over DCN in the dedicated decoupled topology) and
+    ``extra_metrics`` (e.g. ``Params/replay_ratio``), log, and return the new
+    ``last_log``."""
+    metrics = aggregator.compute()
+    aggregator.reset()
+    times = timer_obj.to_dict(reset=True)
+    if extra_times:
+        times = {**times, **{k: times.get(k, 0.0) + v for k, v in extra_times.items()}}
+    steps_since = max(policy_step - last_log, 1)
+    if "Time/env_interaction_time" in times:
+        metrics["Time/sps_env_interaction"] = steps_since / max(times["Time/env_interaction_time"], 1e-9)
+    if "Time/train_time" in times:
+        metrics["Time/sps_train"] = steps_since / max(times["Time/train_time"], 1e-9)
+    if extra_metrics:
+        metrics.update(extra_metrics)
+    metrics.update(times)
+    if logger is not None and metrics:
+        logger.log_metrics(metrics, policy_step)
+    return policy_step

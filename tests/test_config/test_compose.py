@@ -85,3 +85,21 @@ def test_dotdict_helpers():
     merged = deep_merge({"x": {"y": 1, "z": 2}}, {"x": {"y": 10}})
     assert merged == {"x": {"y": 10, "z": 2}}
     assert d.as_dict() == {"a": {"b": 1, "c": {"d": 5}}}
+
+
+def test_nested_group_placement_cli(monkeypatch):
+    """`metric/logger=mlflow` swaps a group instance placed at a nested path
+    (the `/logger@logger:` defaults packaging) from the CLI — hydra's
+    `logger@metric.logger=...` equivalent."""
+    from sheeprl_tpu.config.compose import ConfigError, compose
+
+    # strict oc.env: missing variable with no default fails fast
+    monkeypatch.delenv("MLFLOW_TRACKING_URI", raising=False)
+    with pytest.raises(ConfigError):
+        compose(["exp=ppo", "env.id=x", "metric/logger=mlflow"])
+    monkeypatch.setenv("MLFLOW_TRACKING_URI", "http://tracking:5000")
+    cfg = compose(["exp=ppo", "env.id=x", "metric/logger=mlflow"])
+    assert cfg.metric.logger.kind == "mlflow"
+    assert cfg.metric.logger.tracking_uri == "http://tracking:5000"
+    # the default instance is untouched without the override
+    assert compose(["exp=ppo", "env.id=x"]).metric.logger.kind == "tensorboard"

@@ -31,6 +31,36 @@ _ALGO_ARGS = {
         "algo.learning_starts=0",
         "algo.hidden_size=16",
     ],
+    # dedicated cross-process player/trainer split: process 0 = envs-only
+    # player, process 1 = trainer sub-mesh (reference decoupled topology,
+    # sheeprl/algos/ppo/ppo_decoupled.py:623-670)
+    "ppo_decoupled_dedicated": [
+        "exp=ppo_decoupled",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+        "algo.player.dedicated=True",
+    ],
+    # pixel obs exercise the (T,B,H,W,C) rollout layout on the trainer side
+    # (obs_to_np rollout=True branch) — vector obs alone would miss it
+    "ppo_decoupled_dedicated_pixels": [
+        "exp=ppo_decoupled",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+        "algo.player.dedicated=True",
+        "algo.cnn_keys.encoder=[rgb]",
+        "env.screen_size=32",
+    ],
+    "sac_decoupled_dedicated": [
+        "exp=sac_decoupled",
+        "env.id=continuous_dummy",
+        "algo.learning_starts=0",
+        "algo.hidden_size=16",
+        "algo.player.dedicated=True",
+        "algo.player.sync_every=1",
+        "buffer.checkpoint=True",
+    ],
     # vector-obs DreamerV3 (no CNN): exercises the sequential-replay block
     # assembly + per-rank sampling + PlayerSync paths multi-process
     "dreamer_v3": [
@@ -103,7 +133,17 @@ def _free_port() -> int:
 
 
 @pytest.mark.slow
-@pytest.mark.parametrize("algo", ["ppo", "sac", "dreamer_v3"])
+@pytest.mark.parametrize(
+    "algo",
+    [
+        "ppo",
+        "sac",
+        "dreamer_v3",
+        "ppo_decoupled_dedicated",
+        "ppo_decoupled_dedicated_pixels",
+        "sac_decoupled_dedicated",
+    ],
+)
 def test_two_process_training(tmp_path, algo):
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
