@@ -47,6 +47,11 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
     continue_scale = float(cfg.algo.world_model.continue_scale_factor)
     WM = GaussianWorldModel
 
+    remat = bool(cfg.algo.get("remat", False))
+
+    def maybe_remat(f):
+        return jax.checkpoint(f) if remat else f
+
     def wm_forward(wm_params, data, k):
         L, B = data["rewards"].shape
         obs = normalize_obs_block(data, cnn_keys, obs_keys)
@@ -65,7 +70,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
 
         keys = jax.random.split(k, L)
         _, (hs, zs, post_m, prior_m) = jax.lax.scan(
-            step, (jnp.zeros((B, rec_size)), jnp.zeros((B, stoch))),
+            maybe_remat(step), (jnp.zeros((B, rec_size)), jnp.zeros((B, stoch))),
             (embed, actions, is_first, keys),
         )
         latents = jnp.concatenate([zs, hs], -1)
@@ -125,7 +130,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
 
             keys = jax.random.split(k, horizon + 1)
             _, (traj, actions_seq) = jax.lax.scan(
-                img_step, (start_latents[:, stoch:], start_latents[:, :stoch]), keys
+                maybe_remat(img_step), (start_latents[:, stoch:], start_latents[:, :stoch]), keys
             )
             flat_traj = traj.reshape((horizon + 1) * n, -1)
             if reward_kind == "intrinsic":

@@ -124,6 +124,11 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
     use_continues = bool(cfg.algo.world_model.use_continues)
     discount_scale = float(cfg.algo.world_model.discount_scale_factor)
 
+    remat = bool(cfg.algo.get("remat", False))
+
+    def maybe_remat(f):
+        return jax.checkpoint(f) if remat else f
+
     def wm_forward(wm_params, data, k):
         L, B = data["rewards"].shape
         obs = normalize_obs_block(data, cnn_keys, obs_keys)
@@ -144,7 +149,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
 
         keys = jax.random.split(k, L)
         _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-            step, (h0, z0), (embed, actions, is_first, keys)
+            maybe_remat(step), (h0, z0), (embed, actions, is_first, keys)
         )
         latents = jnp.concatenate([zs, hs], -1)
         flat_latents = latents.reshape(L * B, -1)
@@ -216,7 +221,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
             h0 = start_latents[:, stoch_flat:]
             z0 = start_latents[:, :stoch_flat]
             keys = jax.random.split(k, horizon + 1)
-            _, (traj, actions_seq) = jax.lax.scan(img_step, (h0, z0), keys)
+            _, (traj, actions_seq) = jax.lax.scan(maybe_remat(img_step), (h0, z0), keys)
             flat_traj = traj.reshape((horizon + 1) * n, -1)
             if reward_kind == "intrinsic":
                 # ensemble disagreement over next-state predictions

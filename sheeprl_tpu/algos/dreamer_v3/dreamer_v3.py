@@ -266,7 +266,11 @@ def dreamer_family_loop(
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
 
+    from sheeprl_tpu.utils.profiler import ProfilerGate
+
+    profiler = ProfilerGate(cfg, log_dir)
     for update in range(start_iter, total_iters + 1):
+        profiler.step(update)
         policy_step += policy_steps_per_iter
         with timer("Time/env_interaction_time"):
             if update <= learning_starts and not state:
@@ -386,10 +390,6 @@ def dreamer_family_loop(
                 per_rank_gradient_steps = 1 if update == total_iters else 0
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    # deferred sync: pull the PREVIOUS window's weights (that
-                    # dispatch has finished) so the env steps above overlapped
-                    # with it (see PlayerSync)
-                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(
                         batch_size,
                         n_samples=per_rank_gradient_steps,
@@ -412,6 +412,10 @@ def dreamer_family_loop(
                     blocks["terminated"] = jnp.asarray(np.asarray(sample["terminated"], np.float32)[..., 0])
                     blocks["is_first"] = jnp.asarray(np.asarray(sample["is_first"], np.float32)[..., 0])
                     blocks = fabric.shard_batch(blocks, axis=2)
+                    # deferred sync AFTER the host-side sample/ship so that
+                    # work overlaps the tail of the previous window's device
+                    # compute (before_dispatch blocks on it — see PlayerSync)
+                    player_params = psync.before_dispatch(player_params)
                     key, tk = jax.random.split(key)
                     params, opt_state, last_metrics = train_phase(
                         params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
@@ -463,6 +467,7 @@ def dreamer_family_loop(
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    profiler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         # the deferred-sync player may be one window stale: sync once more
@@ -495,6 +500,14 @@ def make_train_phase(
         kl_regularizer=float(cfg.algo.world_model.kl_regularizer),
         continue_scale_factor=float(cfg.algo.world_model.continue_scale_factor),
     )
+    # algo.remat: rematerialize the sequential scan bodies on the backward
+    # pass (jax.checkpoint) — trades ~1 extra forward of the cell for not
+    # storing L (resp. horizon) copies of its intermediates in HBM, the
+    # standard lever for fitting bigger batches/sizes on-chip
+    remat = bool(cfg.algo.get("remat", False))
+
+    def maybe_remat(f):
+        return jax.checkpoint(f) if remat else f
 
     def wm_forward(wm_params, data, k):
         """Encoder + RSSM scan + heads → loss and latents for behavior."""
@@ -533,7 +546,7 @@ def make_train_phase(
                 )
                 return h, (h, prior_logits)
 
-            _, (hs, prior_logits) = jax.lax.scan(step, h0, (prev_zs, actions, is_first))
+            _, (hs, prior_logits) = jax.lax.scan(maybe_remat(step), h0, (prev_zs, actions, is_first))
         else:
             def step(carry, xs):
                 h, z = carry
@@ -544,7 +557,7 @@ def make_train_phase(
                 return (h, z), (h, z, post_logits, prior_logits)
 
             _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
-                step, (h0, z0), (embed, actions, is_first, keys)
+                maybe_remat(step), (h0, z0), (embed, actions, is_first, keys)
             )
         latents = jnp.concatenate([zs, hs], -1)  # (L, B, stoch+rec)
         flat_latents = latents.reshape(L * B, -1)
@@ -597,7 +610,7 @@ def make_train_phase(
             keys = jax.random.split(k, horizon + 1)
             # H+1 scan steps emit the pre-action latent each time → traj holds
             # states z0, z'1, ..., z'H (reference diagram, dreamer_v3.py:222-232)
-            _, (traj, actions_seq) = jax.lax.scan(img_step, (h0, z0), keys)
+            _, (traj, actions_seq) = jax.lax.scan(maybe_remat(img_step), (h0, z0), keys)
             # predictions over the whole imagined trajectory
             flat_traj = traj.reshape((horizon + 1) * n, -1)
             rewards = TwoHotEncodingDistribution(

@@ -237,7 +237,11 @@ def main(fabric: Any, cfg: Any) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
 
+    from sheeprl_tpu.utils.profiler import ProfilerGate
+
+    profiler = ProfilerGate(cfg, log_dir)
     for update in range(start_iter, total_iters + 1):
+        profiler.step(update)
         with timer("Time/env_interaction_time"):
             with jax.default_device(host):
                 for _ in range(rollout_steps):
@@ -360,6 +364,7 @@ def main(fabric: Any, cfg: Any) -> None:
                 state=ckpt_state,
             )
 
+    profiler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         test(agent, player_params, cfg, log_dir, logger)

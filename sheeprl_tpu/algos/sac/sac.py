@@ -240,7 +240,11 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
 
+    from sheeprl_tpu.utils.profiler import ProfilerGate
+
+    profiler = ProfilerGate(cfg, log_dir)
     for update in range(start_iter, total_iters + 1):
+        profiler.step(update)
         policy_step += num_envs * fabric.num_processes
         with timer("Time/env_interaction_time"):
             if update <= learning_starts and not state:
@@ -292,10 +296,6 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    # deferred sync: pull the PREVIOUS window's weights (that
-                    # dispatch has finished) so the env steps above overlapped
-                    # with it (see PlayerSync)
-                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(
                         batch_size, n_samples=per_rank_gradient_steps
                     )  # (U, batch, *) block in one host call
@@ -307,6 +307,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                         "terminated": jnp.asarray(sample["terminated"][..., 0]),
                     }
                     batches = fabric.shard_batch(batches, axis=1)
+                    # deferred sync AFTER the host-side sample/ship so that
+                    # work overlaps the tail of the previous window's device
+                    # compute (before_dispatch blocks on it — see PlayerSync)
+                    player_params = psync.before_dispatch(player_params)
                     key, tk = jax.random.split(key)
                     params, opt_state, last_losses = train_phase(
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
@@ -351,6 +355,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
 
+    profiler.close()
     envs.close()
     if fabric.is_global_zero and cfg.algo.run_test:
         # the deferred-sync (decoupled) player may be stale: sync once more

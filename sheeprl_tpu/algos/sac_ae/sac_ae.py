@@ -331,10 +331,6 @@ def main(fabric: Any, cfg: Any) -> None:
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    # deferred sync: pull the PREVIOUS window's weights (that
-                    # dispatch has finished) so the env steps above overlapped
-                    # with it (see PlayerSync)
-                    player_params = psync.before_dispatch(player_params)
                     sample = rb.sample(batch_size, n_samples=per_rank_gradient_steps)
                     batches: Dict[str, jax.Array] = {
                         "actions": jnp.asarray(sample["actions"]),
@@ -353,6 +349,9 @@ def main(fabric: Any, cfg: Any) -> None:
                             x = np.asarray(sample[src], np.float32)
                             batches[src] = jnp.asarray(x.reshape(*x.shape[:2], -1))
                     batches = fabric.shard_batch(batches, axis=1)
+                    # deferred sync AFTER the host-side sample/ship so that work
+                    # overlaps the tail of the previous window's device compute
+                    player_params = psync.before_dispatch(player_params)
                     key, tk = jax.random.split(key)
                     params, opt_state, last_losses = train_phase(
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)

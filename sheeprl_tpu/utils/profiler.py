@@ -1,0 +1,52 @@
+"""Profiler gating for train loops.
+
+The reference has no profiler integration (SURVEY.md §5.1 — named timers
+only); on TPU a ``jax.profiler`` trace is the difference between guessing
+and knowing where the step time goes (MXU utilization, HBM stalls, host
+H2D gaps), so the TPU framework makes it a config switch:
+
+    metric.profiler.enabled=True metric.profiler.start_update=10 \
+    metric.profiler.stop_update=12
+
+captures updates [start, stop) into ``<log_dir>/profiler`` (viewable with
+TensorBoard's profile plugin / xprof).  Updates before ``start_update``
+are skipped so compilation and warm-up never pollute the trace.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+
+class ProfilerGate:
+    """Start/stop ``jax.profiler`` around a window of training updates."""
+
+    def __init__(self, cfg: Any, log_dir: str):
+        pcfg = (cfg.metric.get("profiler", {}) or {}) if "metric" in cfg else {}
+        self.enabled = bool(pcfg.get("enabled", False))
+        self.start_update = int(pcfg.get("start_update", 10))
+        self.stop_update = int(pcfg.get("stop_update", 12))
+        self.trace_dir = os.path.join(log_dir, "profiler")
+        self._on = False
+
+    def step(self, update: int) -> None:
+        """Call once per training update with the loop counter."""
+        if not self.enabled:
+            return
+        import jax
+
+        if not self._on and self.start_update <= update < self.stop_update:
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._on = True
+        elif self._on and update >= self.stop_update:
+            jax.profiler.stop_trace()
+            self._on = False
+
+    def close(self) -> None:
+        if self._on:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._on = False

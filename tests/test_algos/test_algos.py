@@ -554,3 +554,52 @@ def test_end_of_training_model_registration(tmp_path, monkeypatch):
     assert manager.get_latest_version("ppo_discrete_dummy_agent") == 1
     params = manager.load_model("ppo_discrete_dummy_agent")
     assert params is not None
+
+
+def test_dreamer_v3_remat(tmp_path):
+    """algo.remat=True rematerializes the RSSM/imagination scan bodies
+    (jax.checkpoint) — the whole loop must still run and checkpoint."""
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.remat=True",
+            "algo.run_test=False",
+            *TINY_DV3_ARGS,
+        ],
+    )
+    run(args)
+    import glob
+
+    assert glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+
+
+def test_profiler_gate_captures_trace(tmp_path):
+    """metric.profiler.enabled=True captures a jax.profiler trace window
+    into <log_dir>/profiler (TPU-tuning aid; reference has timers only)."""
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo.rollout_steps=4",
+            "algo.per_rank_batch_size=4",
+            "algo.update_epochs=1",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=8",
+            "algo.run_test=False",
+            "algo.total_steps=64",
+            "dry_run=False",
+            "metric.profiler.enabled=True",
+            "metric.profiler.start_update=2",
+            "metric.profiler.stop_update=4",
+        ],
+    )
+    run(args)
+    import glob
+
+    traces = glob.glob(f"{tmp_path}/logs/**/profiler/**/*", recursive=True)
+    assert traces, "no profiler trace captured"
