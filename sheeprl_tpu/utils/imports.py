@@ -19,3 +19,4 @@ _IS_MINERL_AVAILABLE = _available("minerl")
 _IS_MINEDOJO_AVAILABLE = _available("minedojo")
 _IS_DIAMBRA_AVAILABLE = _available("diambra")
 _IS_SMB_AVAILABLE = _available("gym_super_mario_bros")
+_IS_MLFLOW_AVAILABLE = _available("mlflow")

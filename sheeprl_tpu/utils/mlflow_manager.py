@@ -1,0 +1,259 @@
+"""MLflow-backed model registry (reference: sheeprl/utils/mlflow.py:76-427).
+
+Same ``AbstractModelManager`` lifecycle as the filesystem backend, executed
+against an MLflow tracking server (or local ``file:`` store): model params
+are logged as a pickled-pytree artifact under a run, registered as model
+versions, and every lifecycle event (register / transition / delete) appends
+to a markdown MODEL CHANGELOG on both the registered model and the version —
+the same audit-trail behavior the reference maintains.
+
+TPU-side difference from the reference: artifacts are JAX pytrees (pickled
+host arrays), not torch ``state_dict``s — ``load_model`` returns the pytree
+ready for ``jax.device_put``.
+"""
+
+from __future__ import annotations
+
+import getpass
+import os
+import pickle
+import tempfile
+import time
+import warnings
+from typing import Any, Dict, Optional
+
+from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+from sheeprl_tpu.utils.model_manager import AbstractModelManager
+
+VERSION_MD_TEMPLATE = "## **Version {}**\n"
+DESCRIPTION_MD_TEMPLATE = "### Description: \n{}\n"
+
+_PARAMS_ARTIFACT = "params.pkl"
+
+
+def _require_mlflow():
+    if not _IS_MLFLOW_AVAILABLE:
+        raise ModuleNotFoundError(
+            "mlflow is not installed; use FileSystemModelManager or install mlflow "
+            "(model_manager.backend=mlflow requires the optional dependency)"
+        )
+    import mlflow  # noqa: F401  (deferred so the module imports without the dep)
+
+    return mlflow
+
+
+class MlflowModelManager(AbstractModelManager):
+    """Registry backend against an MLflow tracking server
+    (reference: sheeprl/utils/mlflow.py:76-427 — MlflowModelManager)."""
+
+    def __init__(self, tracking_uri: Optional[str] = None, experiment_name: str = "sheeprl_tpu"):
+        mlflow = _require_mlflow()
+        from mlflow.tracking import MlflowClient
+
+        self.tracking_uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI", "file:./mlruns")
+        mlflow.set_tracking_uri(self.tracking_uri)
+        self.experiment_name = experiment_name
+        self.client = MlflowClient()
+
+    # -- helpers ---------------------------------------------------------
+
+    @staticmethod
+    def _get_author_and_date() -> str:
+        try:
+            user = getpass.getuser()
+        except Exception:
+            user = "unknown"
+        return f"### Author: {user}, Date: {time.strftime('%d/%m/%Y %H:%M:%S')}\n"
+
+    @staticmethod
+    def _generate_description(description: Optional[str] = None) -> str:
+        return "" if description is None else DESCRIPTION_MD_TEMPLATE.format(description)
+
+    def _safe_get_stage(self, name: str, version: int) -> Optional[str]:
+        try:
+            return self.client.get_model_version(name, str(version)).current_stage
+        except Exception:
+            warnings.warn(f"Model {name} version {version} not found")
+            return None
+
+    def _append_changelog(self, name: str, version: str, entry: str, version_entry: Optional[str] = None) -> None:
+        """Append ``entry`` to the registered model's changelog and
+        ``version_entry`` (default: same) to the version's own changelog."""
+        model_desc = self.client.get_registered_model(name).description or ""
+        header = "# MODEL CHANGELOG\n" if not model_desc else ""
+        self.client.update_registered_model(name, header + model_desc + entry)
+        if version is not None:
+            ver_desc = self.client.get_model_version(name, version).description or ""
+            ver_header = "# MODEL CHANGELOG\n" if not ver_desc else ""
+            self.client.update_model_version(name, version, ver_header + ver_desc + (version_entry or entry))
+
+    # -- lifecycle -------------------------------------------------------
+
+    def register_model(
+        self, name: str, params: Any, description: str = "", metadata: Optional[Dict] = None
+    ) -> int:
+        """Pickle the params pytree, log it under a run, register the run
+        artifact as a new model version, and append a changelog entry
+        (reference: mlflow.py:88-123)."""
+        mlflow = _require_mlflow()
+        import jax
+
+        mlflow.set_experiment(self.experiment_name)
+        with mlflow.start_run(run_name=f"register-{name}") as run:
+            with tempfile.TemporaryDirectory() as tmp:
+                path = os.path.join(tmp, _PARAMS_ARTIFACT)
+                with open(path, "wb") as f:
+                    pickle.dump(jax.device_get(params), f, protocol=pickle.HIGHEST_PROTOCOL)
+                mlflow.log_artifact(path)
+            model_uri = f"runs:/{run.info.run_id}/{_PARAMS_ARTIFACT}"
+        model_version = mlflow.register_model(model_uri=model_uri, name=name, tags=metadata)
+        entry = (
+            VERSION_MD_TEMPLATE.format(model_version.version)
+            + self._get_author_and_date()
+            + self._generate_description(description or None)
+        )
+        self._append_changelog(name, model_version.version, entry)
+        return int(model_version.version)
+
+    def register_model_from_uri(
+        self, model_location: str, name: str, description: str = "", metadata: Optional[Dict] = None
+    ) -> int:
+        """Register an artifact that already lives in the tracking store
+        (reference signature: register_model(model_location, ...))."""
+        mlflow = _require_mlflow()
+        model_version = mlflow.register_model(model_uri=model_location, name=name, tags=metadata)
+        entry = (
+            VERSION_MD_TEMPLATE.format(model_version.version)
+            + self._get_author_and_date()
+            + self._generate_description(description or None)
+        )
+        self._append_changelog(name, model_version.version, entry)
+        return int(model_version.version)
+
+    def get_latest_version(self, name: str) -> Optional[int]:
+        try:
+            versions = self.client.search_model_versions(f"name='{name}'")
+        except Exception:
+            return None
+        if not versions:
+            return None
+        return max(int(v.version) for v in versions)
+
+    def load_model(self, name: str, version: Optional[int] = None) -> Any:
+        path = self.download_model(name, version)
+        with open(path, "rb") as f:
+            return pickle.load(f)
+
+    def transition_model(self, name: str, version: int, stage: str, description: Optional[str] = None) -> None:
+        """Stage transition + changelog (reference: mlflow.py:139-177)."""
+        previous_stage = self._safe_get_stage(name, version)
+        if previous_stage is None:
+            return
+        if previous_stage.lower() == stage.lower():
+            warnings.warn(f"Model {name} version {version} is already in stage {stage}")
+            return
+        model_version = self.client.transition_model_version_stage(
+            name=name, version=str(version), stage=stage
+        )
+        entry = (
+            "## **Transition:**\n"
+            + f"### Version {model_version.version} from {previous_stage} to {model_version.current_stage}\n"
+            + self._get_author_and_date()
+            + self._generate_description(description)
+        )
+        self._append_changelog(name, str(version), entry)
+
+    def delete_model(self, name: str, version: Optional[int] = None, description: Optional[str] = None) -> None:
+        """Delete one version (changelog on the registered model survives) or,
+        with ``version=None``, the whole registered model
+        (reference: mlflow.py:179-214; the interactive confirm prompt is
+        dropped — this framework's deletion is driven by config/CLI, not a
+        TTY)."""
+        if version is None:
+            try:
+                self.client.delete_registered_model(name)
+            except Exception:
+                warnings.warn(f"Model {name} not found")
+            return
+        stage = self._safe_get_stage(name, version)
+        if stage is None:
+            return
+        self.client.delete_model_version(name, str(version))
+        entry = (
+            "## **Deletion:**\n"
+            + f"### Version {version} from stage: {stage}\n"
+            + self._get_author_and_date()
+            + self._generate_description(description)
+        )
+        # version is gone — changelog only on the registered model
+        model_desc = self.client.get_registered_model(name).description or ""
+        self.client.update_registered_model(name, model_desc + entry)
+
+    def download_model(self, name: str, version: Optional[int] = None, output_path: Optional[str] = None) -> str:
+        """Fetch a version's artifact; returns the local file path
+        (reference: mlflow.py:282-297)."""
+        mlflow = _require_mlflow()
+        version = version or self.get_latest_version(name)
+        if version is None:
+            raise FileNotFoundError(f"No registered versions of model '{name}'")
+        artifact_uri = self.client.get_model_version_download_uri(name, str(version))
+        output_path = output_path or os.path.join(tempfile.gettempdir(), f"sheeprl_tpu_{name}_v{version}")
+        os.makedirs(output_path, exist_ok=True)
+        local = mlflow.artifacts.download_artifacts(artifact_uri=artifact_uri, dst_path=output_path)
+        if os.path.isdir(local):
+            local = os.path.join(local, _PARAMS_ARTIFACT)
+        return local
+
+    def register_best_models(
+        self,
+        experiment_name: str,
+        models_info: Dict[str, Dict[str, Any]],
+        metric: str = "Test/cumulative_reward",
+        mode: str = "max",
+    ) -> Dict[str, int]:
+        """Pick the experiment run with the best ``metric`` and register its
+        model artifacts (reference: mlflow.py:216-280)."""
+        if mode not in ("max", "min"):
+            raise ValueError(f"Mode must be either 'max' or 'min', got {mode}")
+        experiment = self.client.get_experiment_by_name(experiment_name)
+        if experiment is None:
+            return {}
+        runs = self.client.search_runs(experiment_ids=[experiment.experiment_id])
+        models_path = [v["path"] for v in models_info.values()]
+        best_run, best_artifacts = None, None
+        for run in runs:
+            artifacts = [a.path for a in self.client.list_artifacts(run.info.run_id) if a.path in models_path]
+            if not artifacts or run.data.metrics.get(metric) is None:
+                continue
+            if best_run is None or (
+                run.data.metrics[metric] > best_run.data.metrics[metric]
+                if mode == "max"
+                else run.data.metrics[metric] < best_run.data.metrics[metric]
+            ):
+                best_run, best_artifacts = run, set(artifacts)
+        if best_run is None:
+            return {}
+        versions = {}
+        for key, info in models_info.items():
+            if info["path"] in best_artifacts:
+                versions[key] = self.register_model_from_uri(
+                    f"runs:/{best_run.info.run_id}/{info['path']}",
+                    info["name"],
+                    description=info.get("description", ""),
+                    metadata=info.get("tags"),
+                )
+        return versions
+
+
+def get_model_manager(cfg: Any) -> AbstractModelManager:
+    """Backend dispatch from config: ``model_manager.backend={filesystem,mlflow}``."""
+    from sheeprl_tpu.utils.model_manager import FileSystemModelManager
+
+    mm_cfg = cfg.get("model_manager", {}) or {}
+    backend = mm_cfg.get("backend", "filesystem")
+    if backend == "mlflow":
+        return MlflowModelManager(
+            tracking_uri=mm_cfg.get("tracking_uri"),
+            experiment_name=mm_cfg.get("experiment_name", cfg.get("exp_name", "sheeprl_tpu")),
+        )
+    return FileSystemModelManager(mm_cfg.get("registry_root", "models_registry"))

@@ -182,8 +182,12 @@ def register_model_from_checkpoint(
     fabric: Any, cfg: Any, state: Dict[str, Any], models_keys: Optional[set] = None
 ) -> Dict[str, int]:
     """Export checkpointed sub-models to the registry
-    (reference: sheeprl/utils/mlflow.py register_model_from_checkpoint)."""
-    manager = FileSystemModelManager(cfg.get("model_manager", {}).get("registry_root", "models_registry"))
+    (reference: sheeprl/utils/mlflow.py register_model_from_checkpoint).
+    Backend chosen by ``model_manager.backend`` (filesystem default; mlflow
+    when the optional dep is installed — sheeprl_tpu/utils/mlflow_manager.py)."""
+    from sheeprl_tpu.utils.mlflow_manager import get_model_manager
+
+    manager = get_model_manager(cfg)
     agent_state = state.get("agent", {})
     models_cfg = cfg.get("model_manager", {}).get("models", {}) or {}
     versions = {}

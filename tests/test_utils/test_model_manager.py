@@ -97,3 +97,62 @@ def test_extra_modules_import(tmp_path, monkeypatch):
     assert "ext_algo" in algorithm_registry
     algorithm_registry.pop("ext_algo", None)
     sys.modules.pop("ext_algo_pkg", None)
+
+
+# ---- MLflow backend (skip-gated on the optional dep) -----------------------
+
+mlflow_required = pytest.mark.skipif(
+    not __import__("sheeprl_tpu.utils.imports", fromlist=["_IS_MLFLOW_AVAILABLE"])._IS_MLFLOW_AVAILABLE,
+    reason="mlflow not installed",
+)
+
+
+def test_get_model_manager_dispatch(tmp_path):
+    from sheeprl_tpu.utils.mlflow_manager import get_model_manager
+    from sheeprl_tpu.utils.structured import dotdict
+
+    mm = get_model_manager(dotdict({"model_manager": {"registry_root": str(tmp_path / "r")}}))
+    assert isinstance(mm, FileSystemModelManager)
+
+
+def test_mlflow_backend_unavailable_raises():
+    from sheeprl_tpu.utils.imports import _IS_MLFLOW_AVAILABLE
+
+    if _IS_MLFLOW_AVAILABLE:
+        pytest.skip("mlflow installed — gate not exercised")
+    from sheeprl_tpu.utils.mlflow_manager import MlflowModelManager
+
+    with pytest.raises(ModuleNotFoundError):
+        MlflowModelManager(tracking_uri="file:/tmp/nope")
+
+
+@mlflow_required
+def test_mlflow_register_load_roundtrip(tmp_path):
+    from sheeprl_tpu.utils.mlflow_manager import MlflowModelManager
+
+    mm = MlflowModelManager(tracking_uri=f"file:{tmp_path}/mlruns", experiment_name="t")
+    params = {"w": jnp.ones((3, 3))}
+    assert mm.register_model("ppo_agent", params, description="first") == 1
+    assert mm.register_model("ppo_agent", params) == 2
+    assert mm.get_latest_version("ppo_agent") == 2
+    loaded = mm.load_model("ppo_agent", version=1)
+    assert loaded["w"].shape == (3, 3)
+    # changelog maintained on the registered model (reference behavior)
+    desc = mm.client.get_registered_model("ppo_agent").description
+    assert "MODEL CHANGELOG" in desc and "Version 1" in desc and "Version 2" in desc
+
+
+@mlflow_required
+def test_mlflow_transition_and_delete(tmp_path):
+    from sheeprl_tpu.utils.mlflow_manager import MlflowModelManager
+
+    mm = MlflowModelManager(tracking_uri=f"file:{tmp_path}/mlruns", experiment_name="t")
+    mm.register_model("m", {"w": jnp.zeros(2)})
+    mm.transition_model("m", 1, "Staging", description="promote")
+    assert mm._safe_get_stage("m", 1) == "Staging"
+    mm.register_model("m", {"w": jnp.zeros(2)})
+    mm.delete_model("m", 1, description="cleanup")
+    assert mm.get_latest_version("m") == 2
+    assert "Deletion" in mm.client.get_registered_model("m").description
+    mm.delete_model("m")
+    assert mm.get_latest_version("m") is None
