@@ -11,12 +11,17 @@ tile, the intermediates never leave VMEM, and the new recurrent state is the
 only output.
 
 Sizes (DreamerV3-S, fp32): W_in (1056, 512) ≈ 2.2 MB, W_gru (1024, 1536)
-≈ 6.3 MB → comfortably inside the ~16 MB VMEM budget.  M and larger
-presets exceed VMEM with fp32 weights ((1664, 3072) ≈ 20 MB) — the op
-raises a clear error instead of failing in the Mosaic compile; keeping the
-whole-weight-resident design honest means S-class models only.  Larger
-models need an H-tiled two-pass kernel (the 3H LayerNorm couples all gate
-columns) or a model-axis sharding — future work.
+≈ 6.3 MB → comfortably inside the ~16 MB VMEM budget, so the S/XS kernel
+keeps both weight blocks fully VMEM-resident.  M and larger presets exceed
+VMEM with fp32 weights (L: W_gru (2816, 6144) ≈ 69 MB) — those dispatch to
+the H-TILED kernel below (``_pallas_forward_tiled``): the gate projection
+``w_gru`` streams through VMEM in column tiles over a second grid axis
+while the raw gate pre-activations accumulate into a VMEM scratch; at the
+last column step the full-row (3H) LayerNorm — which couples ALL gate
+columns and is why a naive column tiling is wrong — plus the gate
+nonlinearities and the state update run from scratch, and only the (B, H)
+new state is written to HBM.  The intermediate (B, 3H) block never touches
+HBM at ANY preset size.
 
 Autodiff: ``pallas_call`` has no reverse-mode rule, so the op carries a
 ``custom_vjp`` whose backward differentiates the SAME math via XLA.  The
@@ -189,11 +194,11 @@ def _pallas_forward(
 ):
     weight_bytes = 4 * (w_in.size + w_gru.size)
     if weight_bytes > _VMEM_WEIGHT_BUDGET_BYTES:
-        raise ValueError(
-            f"fused RSSM kernel keeps both weight blocks VMEM-resident; this "
-            f"model needs {weight_bytes / 2**20:.1f} MB fp32 > "
-            f"{_VMEM_WEIGHT_BUDGET_BYTES / 2**20:.0f} MB budget.  Use the "
-            "flax path (fused_pallas=False) for M+ presets."
+        # M/L/XL presets: stream w_gru in column tiles instead (same math,
+        # same single-HBM-write-per-row-block contract)
+        return _pallas_forward_tiled(
+            x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+            block_b=min(block_b, 64), interpret=interpret,
         )
     B, ZA = x.shape
     H = h.shape[-1]
@@ -232,6 +237,121 @@ def _pallas_forward(
             pl.BlockSpec((1, 3 * H), lambda i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((bt, H), lambda i: (i, 0)),
+        interpret=interpret,
+    )(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias)
+    return out[:B]
+
+
+# ---------------------------------------------------------------------------
+# H-tiled variant for M/L/XL presets (w_gru too large for VMEM residency)
+# ---------------------------------------------------------------------------
+
+def _rssm_kernel_tiled(
+    x_ref, h_ref,
+    w_in_ref, b_in_ref, ln_in_scale_ref, ln_in_bias_ref,
+    w_gru_ref, gru_scale_ref, gru_bias_ref,
+    out_ref,
+    y_scratch, parts_scratch,
+):
+    """One (batch tile, gate-column tile) step of the streamed recurrent path.
+
+    Grid is (num_batch_tiles, num_col_tiles); for a fixed batch tile the
+    column axis runs sequentially, streaming ``w_gru`` (D+H, tj) tiles from
+    HBM.  ``y`` (the input projection) is computed once at j==0 into VMEM
+    scratch; every j accumulates its raw gate pre-activation columns into
+    ``parts_scratch``; the last j applies the full-3H LayerNorm (it couples
+    every gate column — the reason this kernel is two-phase) + gates + state
+    update and performs the kernel's only HBM write.
+    """
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+    D = y_scratch.shape[-1]
+    H = h_ref.shape[-1]
+    tj = w_gru_ref.shape[-1]
+
+    @pl.when(j == 0)
+    def _input_projection():
+        y = jnp.dot(x_ref[:], w_in_ref[:], preferred_element_type=jnp.float32) + b_in_ref[:]
+        y = _ln(y, ln_in_scale_ref[:], ln_in_bias_ref[:], LN_IN_EPS)
+        y_scratch[:] = jax.nn.silu(y)
+
+    # this column tile's raw pre-activations: [y, h] @ w_gru[:, jt]
+    parts_tile = (
+        jnp.dot(y_scratch[:], w_gru_ref[:D, :], preferred_element_type=jnp.float32)
+        + jnp.dot(h_ref[:], w_gru_ref[D:, :], preferred_element_type=jnp.float32)
+    )
+    parts_scratch[:, pl.ds(j * tj, tj)] = parts_tile
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        parts = _ln(parts_scratch[:], gru_scale_ref[:], gru_bias_ref[:], LN_GRU_EPS)
+        h = h_ref[:]
+        reset = jax.nn.sigmoid(parts[:, :H])
+        cand = jnp.tanh(reset * parts[:, H:2 * H])
+        update = jax.nn.sigmoid(parts[:, 2 * H:] - 1.0)
+        out_ref[:] = update * cand + (1.0 - update) * h
+
+
+def _col_tile(total: int, target: int = 512) -> int:
+    """Largest divisor of ``total`` that is ≤ target and a multiple of 128
+    (TPU lane width); falls back to ``total`` for small models."""
+    if total <= target:
+        return total
+    for t in range(target, 127, -128):
+        if total % t == 0:
+            return t
+    return total
+
+
+def _pallas_forward_tiled(
+    x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
+    block_b: int = 64,
+    interpret: bool = False,
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, ZA = x.shape
+    H = h.shape[-1]
+    D = w_in.shape[-1]
+    f32 = jnp.float32
+    x = x.astype(f32)
+    h = h.astype(f32)
+    w_in = w_in.astype(f32)
+    b_in = b_in.reshape(1, D).astype(f32)
+    ln_in_scale = ln_in_scale.reshape(1, D).astype(f32)
+    ln_in_bias = ln_in_bias.reshape(1, D).astype(f32)
+    w_gru = w_gru.astype(f32)
+    gru_scale = gru_scale.reshape(1, 3 * H).astype(f32)
+    gru_bias = gru_bias.reshape(1, 3 * H).astype(f32)
+
+    bt = min(block_b, B)
+    pad = (-B) % bt
+    if pad:
+        x = jnp.pad(x, ((0, pad), (0, 0)))
+        h = jnp.pad(h, ((0, pad), (0, 0)))
+    tj = _col_tile(3 * H)
+    grid = ((B + pad) // bt, (3 * H) // tj)
+
+    out = pl.pallas_call(
+        _rssm_kernel_tiled,
+        out_shape=jax.ShapeDtypeStruct((B + pad, H), f32),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bt, ZA), lambda i, j: (i, 0)),
+            pl.BlockSpec((bt, H), lambda i, j: (i, 0)),
+            pl.BlockSpec((ZA, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, D), lambda i, j: (0, 0)),
+            pl.BlockSpec((D + H, tj), lambda i, j: (0, j)),  # streamed
+            pl.BlockSpec((1, 3 * H), lambda i, j: (0, 0)),
+            pl.BlockSpec((1, 3 * H), lambda i, j: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((bt, H), lambda i, j: (i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bt, D), f32),       # y (input projection)
+            pltpu.VMEM((bt, 3 * H), f32),   # raw gate pre-activations
+        ],
         interpret=interpret,
     )(x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias)
     return out[:B]
