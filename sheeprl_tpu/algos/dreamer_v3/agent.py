@@ -642,4 +642,7 @@ def build_agent(
             "target_critic": jax.tree.map(jnp.copy, critic_params),
             "moments": {"low": jnp.zeros(()), "high": jnp.zeros(())},
         }
-    return world_model, actor, critic, fabric.replicate(params)
+    # shard_params: replicated on a pure-data mesh; with fabric.mesh_shape
+    # declaring a model axis, large dense kernels (RSSM projections, actor/
+    # critic/head MLPs) are column-sharded over it (TP) — fabric.param_sharding
+    return world_model, actor, critic, fabric.shard_params(params)

@@ -87,8 +87,10 @@ class Fabric:
         precision: str = "32-true",
         callbacks: Optional[Dict[str, Any]] = None,
         mesh_shape: Optional[Dict[str, int]] = None,
+        tp_min_param_size: int = 2**18,
     ):
         self.strategy = strategy
+        self.tp_min_param_size = int(tp_min_param_size)
         self.precision = Precision.from_string(precision)
         self.callbacks: List[Any] = []
         self._callback_cfg = callbacks or {}
@@ -272,6 +274,59 @@ class Fabric:
     def replicate(self, tree: Any) -> Any:
         """Replicate a pytree (params/opt state) across the mesh."""
         return jax.device_put(tree, self.replicated)
+
+    # -- tensor parallelism ------------------------------------------------
+    @property
+    def model_axis(self) -> Optional[str]:
+        """Name of the tensor-parallel mesh axis, or None when the mesh has
+        no ``model`` axis of size > 1 (``fabric.mesh_shape={data: -1, model: k}``)."""
+        if "model" in self.mesh.axis_names and self.mesh.shape["model"] > 1:
+            return "model"
+        return None
+
+    def param_sharding(self, tree: Any, min_size: Optional[int] = None) -> Any:
+        """Per-leaf shardings implementing the TP rule: 2-D kernels with
+        ``size >= tp_min_param_size`` whose output dim divides the ``model``
+        axis are column-sharded (Megatron-style partition of the weight's
+        output features); everything else — biases, LayerNorm params, conv
+        filters, scalars — is replicated.  GSPMD propagates the annotations
+        through the train step and inserts the matching collectives
+        (scaling-book recipe: annotate weights, let XLA place all-gathers).
+        With no ``model`` axis every leaf is replicated, so this is a strict
+        generalization of ``replicate``."""
+        axis = self.model_axis
+        min_size = self.tp_min_param_size if min_size is None else min_size
+        if axis is None:
+            return jax.tree.map(lambda _: self.replicated, tree)
+        if self.num_processes > 1:
+            # the player-sync path (copy_to/to_host) materializes params on
+            # one device from the process-local replica — a column-sharded
+            # array has no such replica across hosts.  Multi-host TP needs a
+            # gather-to-host protocol; fail with the fix spelled out instead
+            # of crashing at the first player refresh.
+            raise NotImplementedError(
+                "tensor parallelism (fabric.mesh_shape with a 'model' axis) is "
+                "currently single-controller only; multi-host runs must use a "
+                "pure data mesh (drop mesh_shape or set model: 1)"
+            )
+        k = self.mesh.shape[axis]
+
+        def rule(x: Any) -> NamedSharding:
+            if (
+                getattr(x, "ndim", 0) == 2
+                and x.size >= min_size
+                and x.shape[-1] % k == 0
+            ):
+                return NamedSharding(self.mesh, P(None, axis))
+            return self.replicated
+
+        return jax.tree.map(rule, tree)
+
+    def shard_params(self, tree: Any, min_size: Optional[int] = None) -> Any:
+        """Place a param-shaped pytree per ``param_sharding``.  Also correct
+        for optimizer states: Adam/RMSProp moments share the kernels' shapes,
+        so the same rule shards them consistently with their params."""
+        return jax.device_put(tree, self.param_sharding(tree, min_size))
 
     def setup_module(self, tree: Any) -> Any:  # reference-API parity alias
         return self.replicate(tree)
@@ -493,6 +548,7 @@ def build_fabric(cfg: Any) -> Fabric:
         precision=fab_cfg.get("precision", "32-true"),
         callbacks=fab_cfg.get("callbacks", {}),
         mesh_shape=fab_cfg.get("mesh_shape", None),
+        tp_min_param_size=fab_cfg.get("tp_min_param_size", 2**18),
     )
     cb_cfg = fab_cfg.get("callbacks", {}) or {}
     if "checkpoint" in cb_cfg:
@@ -533,6 +589,7 @@ def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
     sub.accelerator = fabric.accelerator
     sub.mesh = Mesh(np.asarray(trainer_devices), ("data",))
     sub.data_axis = "data"
+    sub.tp_min_param_size = fabric.tp_min_param_size
     return sub
 
 

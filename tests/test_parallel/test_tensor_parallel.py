@@ -1,0 +1,124 @@
+"""Tensor parallelism: the ``model`` mesh axis must change WHERE params live
+without changing WHAT the train step computes.
+
+Equivalence test (VERDICT r2 #6): one seeded DreamerV3 train step on a
+2×2 data×model CPU mesh vs a single device — same losses, same updated
+params.  The TP rule is fabric.param_sharding (column-sharded large 2-D
+kernels, GSPMD-inserted collectives); howto/run_on_tpu.md documents the
+user-facing switch ``fabric.mesh_shape={data: -1, model: K}``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.parallel.fabric import Fabric, build_fabric
+
+TINY = [
+    "exp=dreamer_v3",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "algo=dreamer_v3_XS",
+    "algo.per_rank_batch_size=4",
+    "algo.per_rank_sequence_length=8",
+    "algo.horizon=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[]",
+    "algo.world_model.encoder.cnn_channels_multiplier=2",
+    "algo.dense_units=32",
+    "algo.world_model.recurrent_model.recurrent_state_size=32",
+    "algo.world_model.transition_model.hidden_size=32",
+    "algo.world_model.representation_model.hidden_size=32",
+    "algo.world_model.discrete_size=4",
+    "algo.world_model.stochastic_size=4",
+    "fabric.accelerator=cpu",
+    "fabric.precision=32-true",
+]
+
+
+def _one_step(devices, mesh_shape=None, tp_min_param_size=None):
+    from sheeprl_tpu.algos.dreamer_v3 import dreamer_v3 as dv3
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import build_dv3_optimizers
+
+    import numpy as onp
+    from gymnasium import spaces
+
+    cfg = compose(TINY + [f"fabric.devices={devices}"])
+    fabric = Fabric(
+        devices=devices,
+        accelerator="cpu",
+        precision="32-true",
+        mesh_shape=mesh_shape,
+        tp_min_param_size=tp_min_param_size or 2**18,
+    )
+    obs_space = spaces.Dict({"rgb": spaces.Box(0, 255, (64, 64, 3), onp.uint8)})
+    world_model, actor, critic, params = build_agent(fabric, (4,), False, cfg, obs_space)
+    wm_opt, actor_opt, critic_opt, opt_state = build_dv3_optimizers(fabric, cfg, params)
+    train_phase = dv3.make_train_phase(
+        fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+        cnn_keys=("rgb",), mlp_keys=(), is_continuous=False,
+    )
+    rng = onp.random.default_rng(0)
+    U, L, B = 1, 8, 4
+    block = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(onp.uint8)),
+        "actions": jnp.asarray(rng.integers(0, 2, (U, L, B, 4)).astype(onp.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(U, L, B)).astype(onp.float32)),
+        "terminated": jnp.zeros((U, L, B), jnp.float32),
+        "is_first": jnp.zeros((U, L, B), jnp.float32),
+    }
+    block = fabric.shard_batch(block, axis=2)
+    params, opt_state, metrics = train_phase(
+        params, opt_state, block, jax.random.PRNGKey(3), jnp.int32(0)
+    )
+    return fabric, jax.device_get(params), jax.device_get(metrics)
+
+
+def test_tp_rule_shards_large_kernels_only():
+    fab = Fabric(
+        devices=4, accelerator="cpu", mesh_shape={"data": -1, "model": 2},
+        tp_min_param_size=64,
+    )
+    tree = {
+        "kernel": jnp.zeros((16, 8)),      # 2-D, big enough, 8 % 2 == 0 -> sharded
+        "bias": jnp.zeros((8,)),           # 1-D -> replicated
+        "small": jnp.zeros((4, 4)),        # below min size -> replicated
+        "odd": jnp.zeros((16, 7)),         # 7 % 2 != 0 -> replicated
+    }
+    sh = fab.param_sharding(tree)
+    assert sh["kernel"].spec == jax.sharding.PartitionSpec(None, "model")
+    for k in ("bias", "small", "odd"):
+        assert sh[k].spec == jax.sharding.PartitionSpec()
+
+
+def test_tp_noop_without_model_axis():
+    fab = Fabric(devices=2, accelerator="cpu")
+    assert fab.model_axis is None
+    sh = fab.param_sharding({"kernel": jnp.zeros((512, 512))})
+    assert sh["kernel"].spec == jax.sharding.PartitionSpec()
+
+
+@pytest.mark.skipif(len(jax.devices()) < 4, reason="needs 4 virtual devices")
+def test_tp_train_step_matches_single_device():
+    """2×2 data×model mesh vs 1 device: seeded DV3 train step equivalence."""
+    fab_tp, params_tp, metrics_tp = _one_step(
+        4, mesh_shape={"data": 2, "model": 2}, tp_min_param_size=1024
+    )
+    # at least one kernel must actually be column-sharded, or TP wasn't on
+    specs = jax.tree_util.tree_leaves(
+        fab_tp.param_sharding({"w": jnp.zeros((64, 32))}, min_size=1024)
+    )
+    assert specs[0].spec == jax.sharding.PartitionSpec(None, "model")
+
+    _, params_1, metrics_1 = _one_step(1)
+    for a, b in zip(jax.tree_util.tree_leaves(metrics_tp), jax.tree_util.tree_leaves(metrics_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5)
+    # params are looser than metrics: Adam's step-1 update divides by
+    # sqrt(v)+eps with v built from one gradient, so reduction-order noise
+    # (sharded matmul + GSPMD collectives) is amplified to ~1e-3 relative;
+    # the tight metrics check above is the functional-equivalence evidence
+    for a, b in zip(jax.tree_util.tree_leaves(params_tp), jax.tree_util.tree_leaves(params_1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-2, atol=1e-4)
