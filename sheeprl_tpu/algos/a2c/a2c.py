@@ -96,9 +96,13 @@ def main(fabric: Any, cfg: Any) -> None:
 
     @jax.jit
     def policy_step_fn(p, obs, k):
+        # key advances INSIDE the jitted step: one dispatch per env step
+        # instead of three (split + fold_in used to run as separate host
+        # programs — measurable at A2C's rollout_steps=5 granularity)
+        k_sample, k_next = jax.random.split(k)
         out, value = agent.apply(p, obs)
-        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
-        return actions, logprob, value[..., 0]
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k_sample, dist_type=dist_type)
+        return actions, logprob, value[..., 0], k_next
 
     @jax.jit
     def values_fn(p, obs):
@@ -160,6 +164,9 @@ def main(fabric: Any, cfg: Any) -> None:
     # multi-host DP collects the same data num_processes times
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
+    # per-rank player key stream, advanced inside policy_step_fn; the main
+    # `key` stays rank-identical for train dispatches
+    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
 
     for update in range(start_iter, total_iters + 1):
         with timer("Time/env_interaction_time"):
@@ -167,12 +174,9 @@ def main(fabric: Any, cfg: Any) -> None:
                 for _ in range(rollout_steps):
                     policy_step += num_envs * fabric.num_processes
                     dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-                    key, sk = jax.random.split(key)
-                    # per-rank sampling: the shared key stream stays rank-identical
-                    # (train-dispatch keys must agree across processes), so fold the
-                    # rank into the PLAYER key only
-                    sk = jax.random.fold_in(sk, rank)
-                    actions, logprobs, _ = policy_step_fn(player_params, dev_obs, sk)
+                    actions, logprobs, _, player_key = policy_step_fn(
+                        player_params, dev_obs, player_key
+                    )
                     actions_np = np.asarray(actions)
                     next_obs, rewards, terminated, truncated, info = envs.step(
                         actions_for_env(actions_np, act_space)

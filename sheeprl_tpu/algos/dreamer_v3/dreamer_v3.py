@@ -68,7 +68,10 @@ def build_dv3_optimizers(fabric, cfg, params, saved_opt_state=None):
     wm_opt = build_optimizer(cfg.algo.world_model.optimizer, cfg.algo.world_model.clip_gradients)
     actor_opt = build_optimizer(cfg.algo.actor.optimizer, cfg.algo.actor.clip_gradients)
     critic_opt = build_optimizer(cfg.algo.critic.optimizer, cfg.algo.critic.clip_gradients)
-    opt_state = fabric.replicate(
+    # shard_params, not replicate: under TP the optimizer moments share the
+    # kernels' shapes, so the same column-sharding rule places them
+    # consistently with their params (no-op on a pure-data mesh)
+    opt_state = fabric.shard_params(
         saved_opt_state
         or {
             "world_model": wm_opt.init(params["world_model"]),
@@ -153,9 +156,11 @@ def dreamer_family_loop(
 
     @partial(jax.jit, static_argnames=("greedy",))
     def player_step(p, carry, obs, k, greedy=False):
-        """(h, z, prev_action) carry; returns new carry + env-space action."""
+        """(h, z, prev_action) carry; returns new carry + env-space action +
+        the advanced key (advancing it in-program saves two host dispatches
+        per env step)."""
         h, z, prev_a = carry
-        k_repr, k_act = jax.random.split(k)
+        k_repr, k_act, k_next = jax.random.split(k, 3)
         embed = world_model.apply(p["world_model"], obs, method=WM.encode)
         is_first = jnp.zeros((h.shape[0], 1))
         h, z, _, _ = world_model.apply(
@@ -169,7 +174,7 @@ def dreamer_family_loop(
             )
         else:
             action = actor.sample(head, k_act, greedy=greedy)
-        return (h, z, action), action
+        return (h, z, action), action, k_next
 
     def init_player_carry(batch: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         return (
@@ -184,7 +189,7 @@ def dreamer_family_loop(
     def player_test_step(p, carry, obs, k, greedy):
         if carry is None:
             carry = tuple(jnp.zeros_like(jnp.asarray(c[:1])) for c in init_player_carry(1))
-        carry, action = player_step(p, carry, obs, k, greedy=greedy)
+        carry, action, _ = player_step(p, carry, obs, k, greedy=greedy)
         a = np.asarray(action)
         if not is_continuous:
             # one-hot branches → index per branch
@@ -265,6 +270,9 @@ def dreamer_family_loop(
     step_data["truncated"] = np.zeros((1, num_envs), np.float32)
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
+    # per-rank player key stream, advanced inside player_step; the main
+    # `key` stays rank-identical for train dispatches
+    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
 
     from sheeprl_tpu.utils.profiler import ProfilerGate
 
@@ -289,16 +297,11 @@ def dreamer_family_loop(
             else:
                 with jax.default_device(host):
                     dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-                    key, sk = jax.random.split(key)
-                    # per-rank sampling: the shared key stream stays rank-identical
-                    # (train-dispatch keys must agree across processes), so fold the
-                    # rank into the PLAYER key only
-                    sk = jax.random.fold_in(sk, rank)
-                    new_carry, action_oh = player_step(
+                    new_carry, action_oh, player_key = player_step(
                         player_params,
                         tuple(jnp.asarray(c) for c in player_carry),
                         dev_obs,
-                        sk,
+                        player_key,
                     )
                     player_carry = tuple(np.array(c) for c in new_carry)
                     actions = np.asarray(action_oh, np.float32)

@@ -68,9 +68,11 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
 
     @jax.jit
     def policy_step_fn(p, obs, k):
+        # key advances INSIDE the jitted step (one host dispatch per env step)
+        k_sample, k_next = jax.random.split(k)
         out, value = agent.apply(p, obs)
-        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
-        return actions, logprob, value[..., 0]
+        actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k_sample, dist_type=dist_type)
+        return actions, logprob, value[..., 0], k_next
 
     @jax.jit
     def values_fn(p, obs):
@@ -146,13 +148,15 @@ def _run_rollout(ctx, obs, p_params, key, fold_rank=None):
     act_space, gamma = ctx["act_space"], ctx["gamma"]
     steps = 0
     with jax.default_device(ctx["host"]):
+        # one fold at entry starts a (rank-decorrelated) player stream that
+        # then advances INSIDE policy_step_fn — one dispatch per env step;
+        # the base `key` advances once per rollout, rank-identically
+        sk = jax.random.fold_in(key, fold_rank if fold_rank is not None else 997)
+        key, _ = jax.random.split(key)
         for _ in range(ctx["rollout_steps"]):
             steps += ctx["step_increment"]
             dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-            key, sk = jax.random.split(key)
-            if fold_rank is not None:
-                sk = jax.random.fold_in(sk, fold_rank)
-            actions, logprobs, _ = policy_step_fn(p_params, dev_obs, sk)
+            actions, logprobs, _, sk = policy_step_fn(p_params, dev_obs, sk)
             actions_np = np.asarray(actions)
             next_obs, rewards, terminated, truncated, info = envs.step(
                 actions_for_env(actions_np, act_space)

@@ -130,12 +130,14 @@ def main(fabric: Any, cfg: Any) -> None:
 
     @jax.jit
     def policy_step_fn(p, carry, obs, prev_actions, is_first, k):
+        # key advances INSIDE the jitted step (one host dispatch per env step)
+        k_sample, k_next = jax.random.split(k)
         carry, (actor_out, value) = agent.apply(
             p, method=RecurrentPPOAgent.step, carry=carry, obs=obs,
             prev_actions=prev_actions, is_first=is_first,
         )
-        actions, logprob = _sample(actor_out, actions_dim, is_continuous, k)
-        return carry, actions, logprob, value[..., 0]
+        actions, logprob = _sample(actor_out, actions_dim, is_continuous, k_sample)
+        return carry, actions, logprob, value[..., 0], k_next
 
     @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("env_bs", "num_minibatches"))
     def train_phase(p, o_state, rollout, init_carry, last_values, k, ent_coef, env_bs, num_minibatches):
@@ -223,6 +225,9 @@ def main(fabric: Any, cfg: Any) -> None:
     )
     player_params = fabric.to_host(params)
     last_losses = None
+    # per-rank player key stream, advanced inside policy_step_fn; the main
+    # `key` stays rank-identical for train dispatches
+    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
 
     # the train phase is a GLOBAL program: under multi-host the env axis is
     # the concatenation of every process's local envs.  Single-process keeps
@@ -248,18 +253,13 @@ def main(fabric: Any, cfg: Any) -> None:
                         k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
                         for k in mlp_keys
                     }
-                    key, sk = jax.random.split(key)
-                    # per-rank sampling: the shared key stream stays rank-identical
-                    # (train-dispatch keys must agree across processes), so fold the
-                    # rank into the PLAYER key only
-                    sk = jax.random.fold_in(sk, rank)
-                    carry, actions, logprobs, _ = policy_step_fn(
+                    carry, actions, logprobs, _, player_key = policy_step_fn(
                         player_params,
                         (jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
                         dev_obs,
                         jnp.asarray(prev_actions),
                         jnp.asarray(is_first),
-                        sk,
+                        player_key,
                     )
                     carry_np = (np.asarray(carry[0]), np.asarray(carry[1]))
                     actions_np = np.asarray(actions)

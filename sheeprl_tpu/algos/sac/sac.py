@@ -66,8 +66,11 @@ def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha
 
     @partial(jax.jit, static_argnames=("greedy",))
     def act_fn(p, obs, k, greedy=False):
-        a, _ = sample_action(actor, p, obs, k, greedy=greedy)
-        return a
+        # key advances INSIDE the jitted step (one host dispatch per env
+        # step instead of three; callers thread the returned key)
+        k_sample, k_next = jax.random.split(k)
+        a, _ = sample_action(actor, p, obs, k_sample, greedy=greedy)
+        return a, k_next
 
     def one_update(carry, batch_and_key):
         p, o_state, step_idx = carry
@@ -239,6 +242,9 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
+    # per-rank player key stream, advanced inside act_fn; the main `key`
+    # stays rank-identical for train dispatches
+    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
 
     from sheeprl_tpu.utils.profiler import ProfilerGate
 
@@ -253,12 +259,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
             else:
                 with jax.default_device(host):
-                    key, sk = jax.random.split(key)
-                    # per-rank sampling: the shared key stream stays rank-identical
-                    # (train-dispatch keys must agree across processes), so fold the
-                    # rank into the PLAYER key only
-                    sk = jax.random.fold_in(sk, rank)
-                    actions = np.asarray(act_fn(player_params, jnp.asarray(obs_vec), sk))
+                    a, player_key = act_fn(player_params, jnp.asarray(obs_vec), player_key)
+                    actions = np.asarray(a)
                 env_actions = to_env_actions(actions)
             next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
             dones = np.logical_or(terminated, truncated).astype(np.float32)

@@ -218,6 +218,9 @@ def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
         obs, _ = envs.reset(seed=cfg.seed)
         obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
+    # player key stream advanced inside act_fn (single player process: no
+    # rank folding needed — only process 0 steps envs)
+    player_key = jax.random.fold_in(key, 1023) if is_player else None
 
     for update in range(start_iter, total_iters + 1):
         policy_step += policy_steps_per_iter
@@ -230,8 +233,8 @@ def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
                     actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
                 else:
                     with jax.default_device(host):
-                        key, sk = jax.random.split(key)
-                        actions = np.asarray(act_fn(player_params, jnp.asarray(obs_vec), sk))
+                        a, player_key = act_fn(player_params, jnp.asarray(obs_vec), player_key)
+                        actions = np.asarray(a)
                     env_actions = to_env_actions(actions)
                 next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
                 dones = np.logical_or(terminated, truncated).astype(np.float32)

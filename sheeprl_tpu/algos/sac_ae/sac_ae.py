@@ -127,9 +127,11 @@ def main(fabric: Any, cfg: Any) -> None:
 
     @partial(jax.jit, static_argnames=("greedy",))
     def act_fn(p, obs, k, greedy=False):
+        # key advances INSIDE the jitted step (one host dispatch per env step)
+        k_sample, k_next = jax.random.split(k)
         feats = encoder.apply(p["encoder"], obs)
-        a, _ = sample_action(actor, p["actor"], feats, k, greedy=greedy)
-        return a
+        a, _ = sample_action(actor, p["actor"], feats, k_sample, greedy=greedy)
+        return a, k_next
 
     player_params = psync.init(params)
 
@@ -285,6 +287,9 @@ def main(fabric: Any, cfg: Any) -> None:
     # multi-host DP collects the same data num_processes times
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
+    # per-rank player key stream, advanced inside act_fn; the main `key`
+    # stays rank-identical for train dispatches
+    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
 
     for update in range(start_iter, total_iters + 1):
         policy_step += num_envs * fabric.num_processes
@@ -295,12 +300,8 @@ def main(fabric: Any, cfg: Any) -> None:
                 actions = np.clip(2.0 * (env_actions - act_low) / np.where(span == 0, 1, span) - 1.0, -1, 1)
             else:
                 with jax.default_device(host):
-                    key, sk = jax.random.split(key)
-                    # per-rank sampling: the shared key stream stays rank-identical
-                    # (train-dispatch keys must agree across processes), so fold the
-                    # rank into the PLAYER key only
-                    sk = jax.random.fold_in(sk, rank)
-                    actions = np.asarray(act_fn(player_params, _prep(obs, cnn_keys, mlp_keys), sk))
+                    a, player_key = act_fn(player_params, _prep(obs, cnn_keys, mlp_keys), player_key)
+                    actions = np.asarray(a)
                 env_actions = to_env_actions(actions)
             next_obs, rewards, terminated, truncated, info = envs.step(env_actions)
             dones = np.logical_or(terminated, truncated)
