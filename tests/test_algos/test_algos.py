@@ -603,3 +603,41 @@ def test_profiler_gate_captures_trace(tmp_path):
 
     traces = glob.glob(f"{tmp_path}/logs/**/profiler/**/*", recursive=True)
     assert traces, "no profiler trace captured"
+
+
+def test_sac_accelerator_player(tmp_path):
+    """algo.player.device=accelerator routes rollout inference through the
+    first process-local mesh device instead of the host player device
+    (fabric.player_device accelerator branch) — the on-pod big-encoder
+    configuration (VERDICT r2 #9)."""
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.per_rank_batch_size=8",
+            "algo.learning_starts=4",
+            "algo.mlp_keys.encoder=[state]",
+            "algo.player.device=accelerator",
+            "env.max_episode_steps=16",
+            "buffer.size=64",
+        ],
+    )
+    run(args)
+
+
+def test_dreamer_v3_accelerator_player(tmp_path):
+    """Accelerator player through the Dreamer family loop (stateful player:
+    recurrent state carried on the chosen device)."""
+    args = standard_args(
+        tmp_path,
+        extra=[
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            *DV3_XS_ARGS,
+            "algo.player.device=accelerator",
+        ],
+    )
+    run(args)

@@ -1,0 +1,150 @@
+"""Shared learning-validation workloads.
+
+Used by both the opt-in slow tests (tests/test_learning/test_learning.py)
+and the curve-publishing script (benchmarks/learning_curves.py), so the
+validated workload and the published artifact are the same program.
+
+Role model: the reference's README agent-performance section
+(/root/reference/README.md:23-81) — learning curves are the proof artifact
+that the algorithms LEARN, not just run.
+"""
+
+from __future__ import annotations
+
+import csv
+import time
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+COMMON = [
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "metric.log_level=1",
+    "metric/logger=csv",
+    "metric.log_every=500",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "algo.run_test=False",
+    "print_config=False",
+]
+
+# Each workload: (cli args, reward threshold the LAST QUARTER mean must beat,
+# metric whose trend must be DOWN over the run or None).
+WORKLOADS: Dict[str, dict] = {
+    # reference baseline context: PPO CartPole is the published wall-clock
+    # benchmark env; 500 is the env's max return, 400 ≈ solved.
+    "ppo_cartpole": {
+        "args": [
+            "exp=ppo",
+            "env.id=CartPole-v1",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "seed=5",
+            "algo.total_steps=60000",
+            "algo.rollout_steps=128",
+            "algo.per_rank_batch_size=64",
+            "algo.update_epochs=4",
+            "algo.mlp_keys.encoder=[state]",
+        ],
+        "reward_threshold": 400.0,
+        "falling_metric": None,
+    },
+    # Pendulum starts ~-1200/episode; SAC reaches better than -300 when the
+    # critic/actor/alpha machinery works.
+    "sac_pendulum": {
+        "args": [
+            "exp=sac",
+            "env.id=Pendulum-v1",
+            "env.num_envs=4",
+            "env.sync_env=True",
+            "seed=5",
+            "algo.total_steps=20000",
+            "algo.learning_starts=1000",
+            "algo.per_rank_batch_size=128",
+            "algo.replay_ratio=0.5",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=20000",
+        ],
+        "reward_threshold": -300.0,
+        "falling_metric": None,
+    },
+    # DreamerV3-XS, vector obs only (no CNN => CPU-feasible): world-model
+    # loss must fall AND reward must rise well above the random policy.
+    "dreamer_v3_cartpole": {
+        "args": [
+            "exp=dreamer_v3",
+            "env.id=CartPole-v1",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "seed=5",
+            "algo=dreamer_v3_XS",
+            "algo.total_steps=12000",
+            "algo.learning_starts=512",
+            "algo.replay_ratio=0.25",
+            "algo.per_rank_batch_size=8",
+            "algo.per_rank_sequence_length=32",
+            "algo.cnn_keys.encoder=[]",
+            "algo.mlp_keys.encoder=[state]",
+            "buffer.size=12000",
+        ],
+        "reward_threshold": 120.0,  # random CartPole ≈ 20/episode
+        "falling_metric": "Loss/world_model_loss",
+    },
+}
+
+
+def run_workload(name: str, log_dir: str) -> Tuple[List[Tuple[int, float]], Dict[str, List[Tuple[int, float]]]]:
+    """Run one workload; return (reward curve, all logged loss curves)."""
+    from sheeprl_tpu.cli import run
+
+    spec = WORKLOADS[name]
+    run(COMMON + spec["args"] + [f"log_dir={log_dir}"])
+    return read_curves(log_dir)
+
+
+def read_curves(log_dir: str):
+    csvs = sorted(Path(log_dir).glob("**/metrics.csv"))
+    assert csvs, f"no metrics.csv under {log_dir}"
+    rewards: List[Tuple[int, float]] = []
+    losses: Dict[str, List[Tuple[int, float]]] = {}
+    with open(csvs[-1]) as f:
+        for row in csv.DictReader(f):
+            step, name, value = int(row["step"]), row["name"], float(row["value"])
+            if name == "Rewards/rew_avg":
+                rewards.append((step, value))
+            elif name.startswith("Loss/") or name.startswith("State/"):
+                losses.setdefault(name, []).append((step, value))
+    return rewards, losses
+
+
+def last_quarter_mean(curve: List[Tuple[int, float]]) -> float:
+    assert curve, "empty curve"
+    tail = curve[-max(1, len(curve) // 4):]
+    return sum(v for _, v in tail) / len(tail)
+
+
+def first_last_quarter_means(curve: List[Tuple[int, float]]) -> Tuple[float, float]:
+    q = max(1, len(curve) // 4)
+    head, tail = curve[:q], curve[-q:]
+    return (sum(v for _, v in head) / len(head), sum(v for _, v in tail) / len(tail))
+
+
+def check_workload(name: str, rewards, losses) -> Dict[str, float]:
+    """Assert the workload learned; return a summary dict for publishing."""
+    spec = WORKLOADS[name]
+    final = last_quarter_mean(rewards)
+    assert final >= spec["reward_threshold"], (
+        f"{name}: last-quarter mean reward {final:.1f} < threshold {spec['reward_threshold']} "
+        f"(curve tail: {rewards[-5:]})"
+    )
+    summary = {"final_reward": final, "threshold": spec["reward_threshold"]}
+    if spec["falling_metric"]:
+        head, tail = first_last_quarter_means(losses[spec["falling_metric"]])
+        assert tail < head, (
+            f"{name}: {spec['falling_metric']} did not fall ({head:.4f} -> {tail:.4f})"
+        )
+        summary["falling_metric_head"] = head
+        summary["falling_metric_tail"] = tail
+    return summary

@@ -1,0 +1,20 @@
+"""Opt-in learning-validation tests (minutes each on CPU — `pytest -m slow`).
+
+Prove the algorithms LEARN: reward rises past an absolute threshold and
+(DreamerV3) the world-model loss falls.  The fast suite only proves plumbing;
+these are the RL-correctness teeth.  Curves from the same workloads are
+published by benchmarks/learning_curves.py into docs/curves/.
+"""
+
+import pytest
+
+from tests.test_learning.learning_runs import WORKLOADS, check_workload, run_workload
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.mark.parametrize("name", sorted(WORKLOADS))
+def test_learning(tmp_path, name):
+    rewards, losses = run_workload(name, str(tmp_path / "logs"))
+    assert rewards, f"{name}: no episodes completed"
+    check_workload(name, rewards, losses)

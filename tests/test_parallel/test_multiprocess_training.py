@@ -91,7 +91,7 @@ _WORKER = textwrap.dedent(
     jax.config.update("jax_platforms", "cpu")
     jax.distributed.initialize(
         coordinator_address=os.environ["COORD"],
-        num_processes=2,
+        num_processes=int(os.environ.get("SMOKE_NPROC", "2")),
         process_id=int(sys.argv[1]),
     )
     from sheeprl_tpu.cli import run
@@ -104,9 +104,9 @@ _WORKER = textwrap.dedent(
         "env.num_envs=2",
         "env.sync_env=True",
         "env.capture_video=False",
-        "fabric.devices=2",
+        f"fabric.devices={os.environ.get('SMOKE_NPROC', '2')}",
         "fabric.accelerator=cpu",
-        "algo.per_rank_batch_size=4",
+        f"algo.per_rank_batch_size={os.environ.get('SMOKE_BATCH', '4')}",
         "algo.mlp_keys.encoder=[state]",
         "env.max_episode_steps=8",
         "algo.run_test=False",
@@ -145,13 +145,20 @@ def _free_port() -> int:
     ],
 )
 def test_two_process_training(tmp_path, algo):
+    _run_distributed(tmp_path, _ALGO_ARGS[algo], nproc=2)
+
+
+def _run_distributed(tmp_path, algo_args, nproc=2, batch=4, subdir="logs", timeout=420):
     port = _free_port()
     repo_root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    log_dir = str(tmp_path / subdir)
     env = {
         **os.environ,
         "COORD": f"127.0.0.1:{port}",
-        "SMOKE_ALGO_ARGS": ";".join(_ALGO_ARGS[algo]),
-        "SMOKE_LOG_DIR": str(tmp_path / "logs"),
+        "SMOKE_ALGO_ARGS": ";".join(algo_args),
+        "SMOKE_LOG_DIR": log_dir,
+        "SMOKE_NPROC": str(nproc),
+        "SMOKE_BATCH": str(batch),
         "JAX_PLATFORMS": "cpu",
         "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
         "PYTHONPATH": repo_root + os.pathsep + os.environ.get("PYTHONPATH", ""),
@@ -166,12 +173,73 @@ def test_two_process_training(tmp_path, algo):
             stderr=subprocess.STDOUT,
             text=True,
         )
-        for i in range(2)
+        for i in range(nproc)
     ]
     outputs = []
     for p in procs:
-        out, _ = p.communicate(timeout=420)
+        out, _ = p.communicate(timeout=timeout)
         outputs.append(out)
     for i, (p, out) in enumerate(zip(procs, outputs)):
         assert p.returncode == 0, f"rank {i} failed:\n{out}"
         assert f"rank {i} TRAIN OK" in out
+    return log_dir
+
+
+def _final_agent_params(log_dir):
+    import glob
+
+    from sheeprl_tpu.utils.checkpoint import load_checkpoint
+
+    ckpts = sorted(glob.glob(f"{log_dir}/**/ckpt_*.ckpt", recursive=True))
+    assert ckpts, f"no checkpoint under {log_dir}"
+    return load_checkpoint(ckpts[-1])["agent"]
+
+
+@pytest.mark.slow
+def test_dedicated_three_process_two_trainers(tmp_path):
+    """1 player + 2 trainers (VERDICT r2 #5): the lockstep rollout/weight
+    broadcast protocol has to survive a trainer SUB-MESH of size 2, and the
+    result must be seed-identical to the 1-trainer topology — the global
+    batch is the same; only its sharding over trainers differs (GSPMD
+    all-reduce ⇒ same update)."""
+    import jax
+    import numpy as np
+
+    args = [
+        "exp=ppo_decoupled",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=1",
+        "algo.player.dedicated=True",
+    ]
+    # same GLOBAL minibatch (4): 1 trainer × 4/rank  vs  2 trainers × 2/rank
+    dir_1t = _run_distributed(tmp_path, args, nproc=2, batch=4, subdir="logs_1t")
+    dir_2t = _run_distributed(tmp_path, args, nproc=3, batch=2, subdir="logs_2t")
+    p1 = _final_agent_params(dir_1t)
+    p2 = _final_agent_params(dir_2t)
+    flat1 = jax.tree_util.tree_leaves(p1)
+    flat2 = jax.tree_util.tree_leaves(p2)
+    assert len(flat1) == len(flat2)
+    for a, b in zip(flat1, flat2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
+@pytest.mark.slow
+def test_dedicated_three_process_sac(tmp_path):
+    """SAC dedicated topology with 2 trainers: protocol survives (deadlock /
+    skew smoke at >1 trainer; off-policy sampling is rank-decorrelated so
+    exact equivalence is not expected here)."""
+    _run_distributed(
+        tmp_path,
+        [
+            "exp=sac_decoupled",
+            "env.id=continuous_dummy",
+            "algo.learning_starts=0",
+            "algo.hidden_size=16",
+            "algo.player.dedicated=True",
+            "algo.player.sync_every=1",
+        ],
+        nproc=3,
+        batch=2,
+        subdir="logs_sac3",
+    )

@@ -1,0 +1,141 @@
+"""Golden-value regression tests (numeric teeth for the train step).
+
+One seeded end-to-end training iteration per algorithm family (PPO, SAC,
+DreamerV3) through the real CLI on CPU fp32, with every logged loss compared
+against committed expected values.  A sign or scale bug in GAE, KL balancing,
+twin-Q, the entropy terms, etc. changes these numbers far beyond tolerance,
+while the dry-run smokes (tests/test_algos/) would still pass.
+
+Regenerate after an INTENDED numeric change with:
+
+    GOLDEN_REGEN=1 python -m pytest tests/test_regression -q
+
+then review the goldens.json diff like any other code change.
+(Reference test strategy: SURVEY.md §4 — the reference has no numeric
+regression layer either; this exceeds it deliberately.)
+"""
+
+import csv
+import json
+import os
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.cli import run
+
+GOLDENS_PATH = Path(__file__).parent / "goldens.json"
+
+# Tolerance: same-platform CPU fp32 reruns are bit-identical; the slack is
+# for XLA/jax version bumps.  A sign/scale bug moves losses by orders of
+# magnitude more than this.
+RTOL = 5e-3
+ATOL = 1e-5
+
+COMMON = [
+    "dry_run=True",
+    "seed=7",
+    "env=dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "fabric.precision=32-true",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "metric/logger=csv",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "algo.run_test=False",
+    "print_config=False",
+]
+
+TINY_WM = [
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=8",
+    "algo.learning_starts=0",
+    "algo.horizon=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.dense_units=16",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+]
+
+FAMILIES = {
+    "ppo": [
+        "exp=ppo",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=8",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+    ],
+    "sac": [
+        "exp=sac",
+        "env.id=continuous_dummy",
+        "algo.learning_starts=0",
+        "algo.per_rank_batch_size=8",
+        "algo.mlp_keys.encoder=[state]",
+        "buffer.size=100",
+    ],
+    "dreamer_v3": [
+        "exp=dreamer_v3",
+        "env.id=discrete_dummy",
+        "algo=dreamer_v3_XS",
+        *TINY_WM,
+        "algo.replay_ratio=1",
+        "algo.world_model.discrete_size=4",
+        "algo.world_model.stochastic_size=4",
+        "env.screen_size=64",
+        "env.max_episode_steps=20",
+        "buffer.size=200",
+    ],
+}
+
+# Every logged metric whose name contains one of these substrings is golden
+# (state/grad metrics excluded: optimizer hyper-params may legitimately move).
+GOLDEN_METRIC_SUBSTRINGS = ("Loss/", "State/kl", "State/post_entropy", "State/prior_entropy")
+
+
+def _last_metrics(log_root: Path) -> dict:
+    """Last logged value of each golden metric from the run's metrics.csv."""
+    csvs = sorted(log_root.glob("**/metrics.csv"))
+    assert csvs, f"no metrics.csv under {log_root}"
+    out = {}
+    with open(csvs[-1]) as f:
+        for row in csv.DictReader(f):
+            name = row.get("name", "")
+            if any(s in name for s in GOLDEN_METRIC_SUBSTRINGS):
+                out[name] = float(row["value"])
+    return out
+
+
+@pytest.mark.parametrize("family", sorted(FAMILIES))
+def test_golden_train_step(tmp_path, family):
+    run(COMMON + FAMILIES[family] + [f"log_dir={tmp_path}/logs"])
+    got = _last_metrics(tmp_path)
+    assert got, f"{family}: no golden metrics logged"
+
+    goldens = json.loads(GOLDENS_PATH.read_text()) if GOLDENS_PATH.exists() else {}
+    if os.environ.get("GOLDEN_REGEN"):
+        goldens[family] = got
+        GOLDENS_PATH.write_text(json.dumps(goldens, indent=2, sort_keys=True) + "\n")
+        pytest.skip(f"regenerated goldens for {family}")
+
+    assert family in goldens, f"no goldens for {family}; run with GOLDEN_REGEN=1"
+    expected = goldens[family]
+    assert set(got) == set(expected), (
+        f"{family}: metric set changed: +{set(got) - set(expected)} -{set(expected) - set(got)}; "
+        "regenerate goldens if intended"
+    )
+    for name, want in expected.items():
+        have = got[name]
+        assert have == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"{family}: {name} = {have!r}, golden {want!r} — numeric behavior changed; "
+            "if intended, GOLDEN_REGEN=1 and review the diff"
+        )
