@@ -1,0 +1,213 @@
+"""TPU-revival self-capture harness (VERDICT r3 #1: prober -> actor).
+
+Three rounds of benches have been blocked on a wedged accelerator tunnel;
+twice it revived briefly between probes and the window was lost.  This
+script converts tunnel luck into zero-latency capture: the /tmp watchdog
+loop invokes it the moment a real dispatch succeeds, and it runs the full
+staged capture sequence, appending every result to BENCH_TPU.md and
+committing the artifact:
+
+  1. ``python bench.py``            — DV3-S B=16 L=64 updates/s + MFU
+                                      (baseline 0.5 updates/s, RTX 3080,
+                                      /root/reference/README.md:44-51)
+  2. ``benchmarks/bench_gru_pallas.py`` — Pallas vs XLA A/B at preset shapes
+  3. XL shape check                 — BENCH_SIZE=XL single update compiles+runs
+  4. partial DV3-S learning run     — ~30 min pixel DMC walker_walk slice,
+                                      curve appended
+
+Each stage runs in a child process under its own hard timeout so a re-wedge
+mid-capture loses one stage, not the harness.  A lock file makes the capture
+run at most once per revival; stages already marked done are skipped so a
+second revival resumes where the first died.
+
+Usage:  python benchmarks/tpu_revival.py            (invoked by the watchdog)
+        FORCE=1 python benchmarks/tpu_revival.py    (ignore the done-marks)
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+OUT = REPO / "BENCH_TPU.md"
+STATE = REPO / "benchmarks" / ".tpu_revival_state.json"
+LOCK = pathlib.Path("/tmp/tpu_revival.lock")
+
+STAGES = [
+    # (name, argv, extra env, timeout seconds)
+    (
+        "dv3_s_bench",
+        [sys.executable, "bench.py"],
+        {"BENCH_TIMEOUT": "1800"},
+        2100,
+    ),
+    (
+        "pallas_ab",
+        [sys.executable, "benchmarks/bench_gru_pallas.py"],
+        {},
+        1800,
+    ),
+    (
+        "xl_shape_check",
+        [sys.executable, "bench.py"],
+        {"BENCH_SIZE": "XL", "BENCH_B": "8", "BENCH_L": "32", "BENCH_U": "1", "BENCH_TIMEOUT": "1800"},
+        2100,
+    ),
+    (
+        "dv3_s_dmc_partial_learning",
+        [
+            sys.executable,
+            "-m",
+            "sheeprl_tpu",
+            "exp=dreamer_v3_dmc_walker_walk",
+            "algo=dreamer_v3_S",
+            "algo.total_steps=20000",
+            "algo.learning_starts=1024",
+            "algo.run_test=False",
+            "env.num_envs=1",
+            "metric.log_level=1",
+            "metric/logger=csv",
+            "metric.log_every=500",
+            "checkpoint.every=0",
+            "checkpoint.save_last=False",
+            "print_config=False",
+            "log_dir=/tmp/tpu_revival_learning",
+        ],
+        {"MUJOCO_GL": "egl"},
+        2400,  # hard 40-min ceiling; whatever it reached is the datapoint
+    ),
+]
+
+
+def load_state() -> dict:
+    try:
+        return json.loads(STATE.read_text())
+    except (OSError, ValueError):
+        return {}
+
+
+def mark(state: dict, name: str, rec: dict) -> None:
+    state[name] = rec
+    STATE.write_text(json.dumps(state, indent=2) + "\n")
+
+
+def append_md(title: str, body: str) -> None:
+    stamp = datetime.datetime.now().isoformat(timespec="seconds")
+    if not OUT.exists():
+        OUT.write_text(
+            "# TPU capture log\n\nAppended automatically by "
+            "`benchmarks/tpu_revival.py` on tunnel revival.\n"
+        )
+    with OUT.open("a") as f:
+        f.write(f"\n## {title} ({stamp})\n\n{body}\n")
+
+
+def tail_learning_curve(log_root: str) -> str:
+    """Summarize the partial learning run's metrics.csv (even a killed run
+    leaves a readable curve)."""
+    import csv
+
+    rows = []
+    for p in sorted(pathlib.Path(log_root).glob("**/metrics.csv")):
+        with open(p) as f:
+            rows += [r for r in csv.DictReader(f)]
+    if not rows:
+        return "no metrics logged"
+    lines = ["| step | metric | value |", "|---|---|---|"]
+    keep = ("Rewards/rew_avg", "Loss/world_model_loss", "Loss/policy_loss", "Loss/value_loss")
+    kept = [r for r in rows if r.get("name") in keep]
+    for r in kept[-24:]:
+        lines.append(f"| {r['step']} | {r['name']} | {float(r['value']):.4f} |")
+    return "\n".join(lines)
+
+
+def run_stage(name: str, argv: list, env_extra: dict, timeout_s: int) -> dict:
+    env = {**os.environ, **env_extra}
+    try:
+        child = subprocess.run(
+            argv, cwd=REPO, env=env, timeout=timeout_s, capture_output=True, text=True
+        )
+        out = (child.stdout or "").strip()
+        err_tail = "\n".join((child.stderr or "").strip().splitlines()[-10:])
+        # a CPU-fallback bench exits 0 but is NOT the TPU capture this
+        # harness exists for — don't mark the stage done or the real
+        # number is never taken without FORCE=1
+        ok = child.returncode == 0 and "CPU fallback" not in out
+    except subprocess.TimeoutExpired as e:
+        out = ((e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")).strip()
+        err_tail = f"TIMEOUT after {timeout_s}s"
+        ok = False
+    body = f"```\n{out[-4000:] or '(no stdout)'}\n```"
+    if not ok:
+        body += f"\n\nstage rc!=0 / timeout; stderr tail:\n```\n{err_tail[-1500:]}\n```"
+    if name == "dv3_s_dmc_partial_learning":
+        body += "\n\ncurve tail:\n\n" + tail_learning_curve("/tmp/tpu_revival_learning")
+    append_md(name, body)
+    return {"ok": ok, "stdout_tail": out[-400:], "when": datetime.datetime.now().isoformat()}
+
+
+def git_commit() -> None:
+    subprocess.run(["git", "add", "BENCH_TPU.md", str(STATE.relative_to(REPO))], cwd=REPO)
+    subprocess.run(
+        ["git", "commit", "-m", "TPU capture: bench + Pallas A/B + partial learning run"],
+        cwd=REPO,
+        capture_output=True,
+    )
+
+
+def main() -> int:
+    # at-most-once per revival: O_EXCL lock, held for the process lifetime
+    try:
+        fd = os.open(LOCK, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        os.write(fd, str(os.getpid()).encode())
+        os.close(fd)
+    except FileExistsError:
+        # stale lock from a dead capture? only steal it if that pid is GONE —
+        # PermissionError means the pid exists (another user): NOT stale
+        try:
+            pid = int(LOCK.read_text())
+            os.kill(pid, 0)
+            print("[tpu_revival] capture already running; exiting")
+            return 0
+        except PermissionError:
+            print("[tpu_revival] capture already running (other user); exiting")
+            return 0
+        except (ValueError, ProcessLookupError):
+            LOCK.write_text(str(os.getpid()))
+
+    try:
+        # the watchdog invokes this on a CONFIRMED live dispatch; a stale
+        # 'wedged' probe-cache entry (TTL 600s) must not make bench.py fall
+        # back to CPU during the live window
+        sys.path.insert(0, str(REPO))
+        from sheeprl_tpu.utils.utils import _PROBE_CACHE_PATH
+
+        try:
+            os.unlink(_PROBE_CACHE_PATH)
+        except OSError:
+            pass
+        state = {} if os.environ.get("FORCE") else load_state()
+        for name, argv, env_extra, timeout_s in STAGES:
+            if state.get(name, {}).get("ok"):
+                print(f"[tpu_revival] {name}: already captured, skipping")
+                continue
+            print(f"[tpu_revival] running {name} ...", flush=True)
+            rec = run_stage(name, argv, env_extra, timeout_s)
+            mark(state, name, rec)
+            git_commit()
+            print(f"[tpu_revival] {name}: ok={rec['ok']}", flush=True)
+        return 0
+    finally:
+        try:
+            LOCK.unlink()
+        except OSError:
+            pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

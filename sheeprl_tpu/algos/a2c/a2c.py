@@ -140,6 +140,11 @@ def main(fabric: Any, cfg: Any) -> None:
 
     rollout_steps = int(cfg.algo.rollout_steps)
     sharded_envs, _ = fabric.env_sharding_plan(num_envs, "A2C")
+    # buffer.share_data needs no branch here: this A2C takes ONE full-batch
+    # gradient step over the global rollout, so the "shared global pool"
+    # (share_data=True) and "per-rank batches + gradient all-reduce"
+    # (share_data=False) semantics produce the same update by linearity
+    # (reference: sheeprl/algos/a2c/a2c.py:41-54,371 minibatches instead)
     # GLOBAL env-step accounting: every process steps its own envs
     policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)

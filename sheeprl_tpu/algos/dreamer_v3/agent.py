@@ -592,6 +592,21 @@ def build_agent(
         fused_pallas_rssm=bool(wm_cfg.recurrent_model.get("fused_pallas", False)),
         dtype=dtype,
     )
+    if fabric.model_axis and (
+        bool(wm_cfg.recurrent_model.get("use_pallas", False))
+        or bool(wm_cfg.recurrent_model.get("fused_pallas", False))
+    ):
+        # tensor parallelism column-shards 2-D kernels over the model axis;
+        # a pallas_call would receive a sharded w_gru operand — at best a
+        # silent all-gather per step, at worst a Mosaic compile failure.
+        # Enforce the howto/run_on_tpu.md exclusion instead of hoping (ADVICE r3)
+        raise ValueError(
+            "tensor parallelism (fabric.model_parallel_size > 1) cannot be "
+            "combined with the Pallas RSSM kernels: param_sharding would "
+            "column-shard w_gru under the single-device pallas_call. Disable "
+            "algo.world_model.recurrent_model.{use_pallas,fused_pallas} or "
+            "run without a model axis."
+        )
     actor = Actor(
         actions_dim=tuple(actions_dim),
         is_continuous=is_continuous,

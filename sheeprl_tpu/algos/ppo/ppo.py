@@ -55,6 +55,46 @@ from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
 from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
 
 
+def epoch_permutation(
+    key, T: int, B: int, batch_size: int, num_minibatches: int, share_data: bool, n_shards: int
+) -> jax.Array:
+    """Flat sample order for one PPO epoch over the (T, B) rollout, laid out
+    as ``num_minibatches`` consecutive ``batch_size`` slices.
+
+    * ``share_data=True`` (or one shard): one permutation of the GLOBAL
+      (T·B) pool, padded by wrap-around to fill the last minibatch — the
+      reference's all-gather + DistributedSampler pool semantics
+      (reference: sheeprl/algos/ppo/ppo.py:363-370,41-47).
+    * ``share_data=False`` with ``n_shards`` processes: classic DDP — each
+      process permutes only ITS OWN env columns (process r owns columns
+      [r·B/n, (r+1)·B/n), the shard_batch concatenation order) and every
+      minibatch interleaves an equal ``batch_size/n_shards`` slice from each
+      process, so the sample gather stays shard-local on a TPU mesh.
+    """
+    if share_data or n_shards == 1:
+        perm = jax.random.permutation(key, T * B)
+        pad = num_minibatches * batch_size - (T * B)
+        return jnp.concatenate([perm, perm[: max(pad, 0)]]) if pad > 0 else perm
+    b_loc = B // n_shards
+    rows = T * b_loc
+    pr_bs = batch_size // n_shards
+
+    def rank_perm(kr, r):
+        pl = jax.random.permutation(kr, rows)
+        t_idx, b_idx = pl // b_loc, pl % b_loc
+        return t_idx * B + r * b_loc + b_idx
+
+    perms = jax.vmap(rank_perm)(jax.random.split(key, n_shards), jnp.arange(n_shards))
+    pad = num_minibatches * pr_bs - rows
+    if pad > 0:
+        perms = jnp.concatenate([perms, perms[:, :pad]], axis=1)
+    return (
+        perms.reshape(n_shards, num_minibatches, pr_bs)
+        .transpose(1, 0, 2)
+        .reshape(num_minibatches * batch_size)
+    )
+
+
 @register_algorithm()
 def main(fabric: Any, cfg: Any) -> None:
     rank = fabric.global_rank
@@ -140,7 +180,11 @@ def main(fabric: Any, cfg: Any) -> None:
         ent = entropy_loss(entropy, reduction)
         return pg + vf_coef * vl + ent_coef * ent, (pg, vl, ent)
 
-    @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("batch_size", "num_minibatches"))
+    @partial(
+        jax.jit,
+        donate_argnums=(0, 1),
+        static_argnames=("batch_size", "num_minibatches", "share_data", "n_shards"),
+    )
     def train_phase(
         p,
         o_state,
@@ -151,8 +195,22 @@ def main(fabric: Any, cfg: Any) -> None:
         ent_coef,
         batch_size: int,
         num_minibatches: int,
+        share_data: bool = True,
+        n_shards: int = 1,
     ):
-        """GAE + all epochs/minibatches in ONE device program."""
+        """GAE + all epochs/minibatches in ONE device program.
+
+        ``share_data`` selects the reference's two DP minibatch semantics
+        (reference: sheeprl/algos/ppo/ppo.py:40-55,363-370):
+        * True — every rank minibatches the GLOBAL rollout pool (the
+          reference all-gathers + DistributedSampler); here a global
+          permutation over the sharded (T·B) pool does it with no explicit
+          gather — XLA moves only the rows each step needs.
+        * False — classic DDP: each of the ``n_shards`` processes permutes
+          only ITS OWN env columns and contributes ``batch_size/n_shards``
+          rows per step; the sample gather stays shard-local (no cross-host
+          traffic), gradients combine exactly as DDP's all-reduce would.
+        """
         # --- GAE (values recomputed in one batched forward) ---
         T, B = rollout["rewards"].shape
         flat_obs = {key_: rollout[key_].reshape((T * B,) + rollout[key_].shape[2:]) for key_ in obs_keys}
@@ -172,13 +230,9 @@ def main(fabric: Any, cfg: Any) -> None:
 
         def epoch_body(carry, key_e):
             p, o_state = carry
-            perm = jax.random.permutation(key_e, T * B)
-            # pad by wrap-around so the epoch covers EVERY sample even when
-            # T*B is not divisible by the batch size (a handful of samples
-            # are then seen twice; the reference's smaller tail batch has no
-            # static-shape equivalent)
-            pad = num_minibatches * batch_size - (T * B)
-            perm = jnp.concatenate([perm, perm[: max(pad, 0)]]) if pad > 0 else perm
+            perm = epoch_permutation(
+                key_e, T, B, batch_size, num_minibatches, share_data, n_shards
+            )
 
             def mb_body(i, carry2):
                 p, o_state, losses = carry2
@@ -209,6 +263,20 @@ def main(fabric: Any, cfg: Any) -> None:
     T, B = rollout_steps, global_envs
     global_bs = min(int(cfg.algo.per_rank_batch_size) * fabric.world_size, T * B)
     num_minibatches = -(-T * B // global_bs)  # ceil: keep the tail
+    # reference semantics (ppo.py:363-370): share_data only changes anything
+    # across processes; the per-process shards must admit equal batch slices
+    share_data = bool(cfg.buffer.get("share_data", False))
+    n_shards = fabric.num_processes if sharded_envs else 1
+    if n_shards > 1 and (global_bs % n_shards or B % n_shards):
+        if not share_data:
+            import warnings
+
+            warnings.warn(
+                f"buffer.share_data=False needs equal per-process batch slices "
+                f"(batch {global_bs}, envs {B}, processes {n_shards}): falling "
+                "back to the global-pool (share_data=True) sampler"
+            )
+        n_shards = 1  # uneven split: fall back to the global-pool sampler
     # GLOBAL env-step accounting: every process steps its own envs
     policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
@@ -323,6 +391,8 @@ def main(fabric: Any, cfg: Any) -> None:
                 jnp.float32(ent_coef_v),
                 batch_size=global_bs,
                 num_minibatches=num_minibatches,
+                share_data=share_data,
+                n_shards=n_shards,
             )
             # refresh the host player once per iteration (one d2h transfer)
             player_params = fabric.to_host(params)

@@ -196,6 +196,14 @@ def _run_rollout(ctx, obs, p_params, key, fold_rank=None):
 
 @register_algorithm(decoupled=True, name="ppo_decoupled")
 def main(fabric: Any, cfg: Any) -> None:
+    if cfg.buffer.get("share_data", False):
+        import warnings
+
+        warnings.warn(
+            "buffer.share_data=True is ignored by decoupled PPO: the player "
+            "already collects ONE global rollout that every trainer minibatches "
+            "(reference: sheeprl/algos/ppo/ppo_decoupled.py:639-643)"
+        )
     dedicated = (cfg.algo.get("player", {}) or {}).get("dedicated", False)
     if dedicated and fabric.num_processes > 1:
         return _dedicated_main(fabric, cfg)

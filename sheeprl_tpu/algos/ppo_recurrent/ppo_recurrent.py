@@ -79,6 +79,14 @@ def _sample(actor_out, actions_dim, is_continuous, key, greedy=False):
 
 @register_algorithm()
 def main(fabric: Any, cfg: Any) -> None:
+    if cfg.buffer.get("share_data", False):
+        import warnings
+
+        warnings.warn(
+            "buffer.share_data=True: with recurrent PPO only gradients are "
+            "shared — per-env hidden-state sequences stay on their process "
+            "(reference: sheeprl/algos/ppo_recurrent/ppo_recurrent.py:132-135)"
+        )
     rank = fabric.global_rank
     key = fabric.seed_everything(cfg.seed)
 

@@ -530,9 +530,13 @@ class EpisodeBuffer:
         sequence_length: Optional[int] = None,
         **kwargs: Any,
     ) -> Arrays:
-        """Returns ``(n_samples, L, batch_size, *)`` sequences drawn from
-        committed episodes, length-weighted; with ``prioritize_ends`` the
-        start distribution is shifted so episode tails are over-sampled."""
+        """Returns ``(n_samples, L, batch_size, *)`` sequences: episodes are
+        chosen UNIFORMLY among those long enough (reference semantics —
+        data/buffers.py:1077-1080 uses a uniform randint over valid episodes,
+        NOT length weighting), then a start index uniform over the valid
+        range; with ``prioritize_ends`` the start draw runs over the FULL
+        episode and clamps to the last valid start, so the final window
+        carries (L+1)/(ep_len+1) of the mass (reference: buffers.py:1092-1099)."""
         L = sequence_length or self._sequence_length
         if not self._episodes:
             raise RuntimeError("Cannot sample from an empty EpisodeBuffer")
@@ -540,10 +544,8 @@ class EpisodeBuffer:
         eligible = np.where(lengths >= L)[0]
         if eligible.size == 0:
             raise RuntimeError(f"No episode is >= sequence_length={L}")
-        weights = lengths[eligible].astype(np.float64)
-        probs = weights / weights.sum()
         total = batch_size * n_samples
-        chosen = np.random.choice(eligible, size=total, p=probs)
+        chosen = np.random.choice(eligible, size=total)
         keys = self._episodes[0].keys()
         gathered: Dict[str, List[np.ndarray]] = {k: [] for k in keys}
         for ep_idx in chosen:
@@ -551,7 +553,7 @@ class EpisodeBuffer:
             ep_len = lengths[ep_idx]
             max_start = ep_len - L
             if self._prioritize_ends:
-                start = min(np.random.randint(0, ep_len), max_start)
+                start = min(np.random.randint(0, ep_len + 1), max_start)
             else:
                 start = np.random.randint(0, max_start + 1)
             for k in keys:

@@ -36,6 +36,14 @@ GRU LN eps 1e-5 (models.LayerNorm defaults), Hafner ``-1`` update-gate bias.
 Validated against the flax modules in tests/test_models/test_rssm_pallas.py
 with ``interpret=True`` (no TPU needed).  Enable inside the world model with
 ``algo.world_model.recurrent_model.fused_pallas=True`` once on TPU hardware.
+
+HARDWARE STATUS: interpret-mode-validated only — never Mosaic-compiled on a
+real TPU (the accelerator tunnel has been down since round 1; see
+benchmarks/tpu_revival.py, which A/Bs and compiles these kernels the moment
+it revives).  ``use_pallas``/``fused_pallas`` stay off by default until that
+run exists.  The VMEM planner (`_plan_tiled`) sizes the tiled variant's
+working set against `_VMEM_WEIGHT_BUDGET_BYTES` and raises when no legal
+tiling fits, instead of letting Mosaic fail opaquely.
 """
 
 from __future__ import annotations
@@ -303,6 +311,50 @@ def _col_tile(total: int, target: int = 512) -> int:
     return total
 
 
+def _tiled_vmem_bytes(bt: int, tj: int, ZA: int, D: int, H: int) -> int:
+    """Estimated VMEM residency of one `_rssm_kernel_tiled` step (fp32):
+    resident w_in block, the streamed w_gru column tile (×2 for pallas
+    double-buffering), both scratches, and the batch-tile operands/output."""
+    return 4 * (
+        ZA * D                # w_in (resident across the column axis)
+        + 2 * (D + H) * tj    # streamed w_gru tile, double-buffered
+        + bt * D              # y scratch
+        + bt * 3 * H          # parts scratch
+        + bt * (ZA + 2 * H)   # x, h, out tiles
+        + 3 * D + 2 * 3 * H   # LN/bias vectors
+    )
+
+
+def _plan_tiled(B: int, ZA: int, D: int, H: int, block_b: int):
+    """Pick (bt, tj) so the tiled kernel's working set fits the VMEM budget
+    (ADVICE r3: the tiled path previously had no accounting at all and XL
+    could exceed ~16MB/core).  Prefers shrinking the column tile first (it
+    only adds grid steps), then the batch tile; raises when even the
+    smallest legal tiling cannot fit."""
+    bt = min(block_b, B)
+    while True:
+        tj = _col_tile(3 * H)
+        while (
+            _tiled_vmem_bytes(bt, tj, ZA, D, H) > _VMEM_WEIGHT_BUDGET_BYTES and tj > 128
+        ):
+            # next smaller 128-multiple divisor of 3H
+            smaller = [t for t in range(tj - 128, 127, -128) if (3 * H) % t == 0]
+            if not smaller:
+                break
+            tj = smaller[0]
+        if _tiled_vmem_bytes(bt, tj, ZA, D, H) <= _VMEM_WEIGHT_BUDGET_BYTES:
+            return bt, tj
+        if bt > 8:
+            bt = max(8, bt // 2)
+            continue
+        raise ValueError(
+            f"fused RSSM tiled kernel cannot fit VMEM: D={D} H={H} ZA={ZA} "
+            f"needs {_tiled_vmem_bytes(bt, tj, ZA, D, H) / 2**20:.1f} MiB at the "
+            f"smallest tiling (budget {_VMEM_WEIGHT_BUDGET_BYTES / 2**20:.0f} MiB) "
+            "— disable algo.world_model.recurrent_model.fused_pallas for this preset"
+        )
+
+
 def _pallas_forward_tiled(
     x, h, w_in, b_in, ln_in_scale, ln_in_bias, w_gru, gru_scale, gru_bias,
     block_b: int = 64,
@@ -324,12 +376,11 @@ def _pallas_forward_tiled(
     gru_scale = gru_scale.reshape(1, 3 * H).astype(f32)
     gru_bias = gru_bias.reshape(1, 3 * H).astype(f32)
 
-    bt = min(block_b, B)
+    bt, tj = _plan_tiled(B, ZA, D, H, block_b)
     pad = (-B) % bt
     if pad:
         x = jnp.pad(x, ((0, pad), (0, 0)))
         h = jnp.pad(h, ((0, pad), (0, 0)))
-    tj = _col_tile(3 * H)
     grid = ((B + pad) // bt, (3 * H) // tj)
 
     out = pl.pallas_call(

@@ -20,7 +20,12 @@ import gymnasium as gym
 import numpy as np
 from gymnasium import spaces
 
-from sheeprl_tpu.envs.dummy import ContinuousDummyEnv, DiscreteDummyEnv, MultiDiscreteDummyEnv
+from sheeprl_tpu.envs.dummy import (
+    ContinuousDummyEnv,
+    DiscreteDummyEnv,
+    MultiDiscreteDummyEnv,
+    PixelGridDummyEnv,
+)
 from sheeprl_tpu.envs.wrappers import (
     ActionRepeat,
     ActionsAsObservationWrapper,
@@ -33,6 +38,7 @@ DUMMY_ENVS = {
     "discrete_dummy": DiscreteDummyEnv,
     "multidiscrete_dummy": MultiDiscreteDummyEnv,
     "continuous_dummy": ContinuousDummyEnv,
+    "pixel_grid_dummy": PixelGridDummyEnv,
 }
 
 

@@ -28,6 +28,21 @@ from sheeprl_tpu.utils.model_manager import AbstractModelManager
 VERSION_MD_TEMPLATE = "## **Version {}**\n"
 DESCRIPTION_MD_TEMPLATE = "### Description: \n{}\n"
 
+# Tested optional-dependency range (ADVICE r3): the stage-transition API this
+# backend drives was written against the mlflow 2.x client; mlflow >= 2.9
+# deprecates `transition_model_version_stage` in favor of registered-model
+# aliases (removed in 3.x), for which `transition_model` carries a fallback.
+MLFLOW_TESTED_RANGE = ">=2.0,<2.9"
+
+
+def _mlflow_major_minor() -> tuple:
+    import mlflow
+
+    try:
+        return tuple(int(p) for p in mlflow.__version__.split(".")[:2])
+    except (ValueError, AttributeError):  # dev builds etc.
+        return (0, 0)
+
 _PARAMS_ARTIFACT = "params.pkl"
 
 
@@ -50,6 +65,12 @@ class MlflowModelManager(AbstractModelManager):
         mlflow = _require_mlflow()
         from mlflow.tracking import MlflowClient
 
+        if _mlflow_major_minor() >= (2, 9):
+            warnings.warn(
+                f"mlflow {mlflow.__version__} is outside the tested range "
+                f"{MLFLOW_TESTED_RANGE}: stage transitions fall back to "
+                "registered-model aliases (stages were deprecated in 2.9)"
+            )
         self.tracking_uri = tracking_uri or os.environ.get("MLFLOW_TRACKING_URI", "file:./mlruns")
         mlflow.set_tracking_uri(self.tracking_uri)
         self.experiment_name = experiment_name
@@ -71,10 +92,19 @@ class MlflowModelManager(AbstractModelManager):
 
     def _safe_get_stage(self, name: str, version: int) -> Optional[str]:
         try:
-            return self.client.get_model_version(name, str(version)).current_stage
+            mv = self.client.get_model_version(name, str(version))
         except Exception:
             warnings.warn(f"Model {name} version {version} not found")
             return None
+        stage = getattr(mv, "current_stage", None)
+        if stage in (None, "None"):
+            # alias-mode fallback (mlflow >= 2.9): transition_model records
+            # the stage in a version tag instead — read it back so the
+            # idempotency guard and changelog see the real previous stage
+            tag = (getattr(mv, "tags", None) or {}).get("stage")
+            if tag:
+                return tag
+        return stage
 
     def _append_changelog(self, name: str, version: str, entry: str, version_entry: Optional[str] = None) -> None:
         """Append ``entry`` to the registered model's changelog and
@@ -152,12 +182,29 @@ class MlflowModelManager(AbstractModelManager):
         if previous_stage.lower() == stage.lower():
             warnings.warn(f"Model {name} version {version} is already in stage {stage}")
             return
-        model_version = self.client.transition_model_version_stage(
-            name=name, version=str(version), stage=stage
-        )
+        if hasattr(self.client, "transition_model_version_stage") and _mlflow_major_minor() < (2, 9):
+            model_version = self.client.transition_model_version_stage(
+                name=name, version=str(version), stage=stage
+            )
+            new_stage = model_version.current_stage
+        else:
+            # mlflow >= 2.9: stages are deprecated (removed in 3.x) in favor
+            # of registered-model aliases — the alias IS the stage label.
+            # A version LEAVES its previous stage on transition (stage-API
+            # semantics): drop the old alias if it still points at us.
+            if previous_stage and previous_stage.lower() != "none":
+                try:
+                    held = self.client.get_model_version_by_alias(name, previous_stage.lower())
+                    if str(held.version) == str(version):
+                        self.client.delete_registered_model_alias(name, previous_stage.lower())
+                except Exception:
+                    pass  # no such alias
+            self.client.set_registered_model_alias(name, stage.lower(), str(version))
+            self.client.set_model_version_tag(name, str(version), "stage", stage)
+            new_stage = stage
         entry = (
             "## **Transition:**\n"
-            + f"### Version {model_version.version} from {previous_stage} to {model_version.current_stage}\n"
+            + f"### Version {version} from {previous_stage} to {new_stage}\n"
             + self._get_author_and_date()
             + self._generate_description(description)
         )

@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import copy
 import os
+import tempfile
+import time
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -248,9 +250,36 @@ def copy_cfg(cfg: Any) -> Any:
 
 _ACCELERATOR_ALIVE: Optional[bool] = None
 
+# Cross-process probe cache: a wedged tunnel costs the 90 s subprocess probe
+# once per TTL window, not once per bench target / graft entry (VERDICT r3).
+_PROBE_CACHE_PATH = os.path.join(tempfile.gettempdir(), "sheeprl_tpu_probe_cache")
+_PROBE_CACHE_TTL_S = 600.0
+
+
+def _read_probe_cache() -> Optional[bool]:
+    try:
+        with open(_PROBE_CACHE_PATH) as f:
+            stamp, verdict = f.read().split()
+        if time.time() - float(stamp) <= _PROBE_CACHE_TTL_S:
+            return verdict == "alive"
+    except (OSError, ValueError):
+        pass
+    return None
+
+
+def _write_probe_cache(alive: bool) -> None:
+    try:
+        fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir())
+        with os.fdopen(fd, "w") as f:
+            f.write(f"{time.time()} {'alive' if alive else 'wedged'}")
+        os.replace(tmp, _PROBE_CACHE_PATH)
+    except OSError:
+        pass  # cache is an optimization; the probe result still stands
+
 
 def accelerator_alive(timeout_s: int = 90) -> bool:
-    """Probe the default JAX backend in a SUBPROCESS (memoized per process).
+    """Probe the default JAX backend in a SUBPROCESS (memoized per process,
+    plus a short-TTL cross-process cache file).
 
     A wedged TPU tunnel hangs ``jax.devices()`` forever; probing in a child
     process bounds the damage so callers (bench.py, __graft_entry__.py) can
@@ -258,6 +287,10 @@ def accelerator_alive(timeout_s: int = 90) -> bool:
     """
     global _ACCELERATOR_ALIVE
     if _ACCELERATOR_ALIVE is not None:
+        return _ACCELERATOR_ALIVE
+    cached = _read_probe_cache()
+    if cached is not None:
+        _ACCELERATOR_ALIVE = cached
         return _ACCELERATOR_ALIVE
     import subprocess
     import sys
@@ -281,6 +314,7 @@ def accelerator_alive(timeout_s: int = 90) -> bool:
         )
     except subprocess.TimeoutExpired:
         _ACCELERATOR_ALIVE = False
+    _write_probe_cache(_ACCELERATOR_ALIVE)
     return _ACCELERATOR_ALIVE
 
 

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# The one-command CPU test gate (runs in CI — .github/workflows/cpu-tests.yaml —
+# and locally).  Parity role model: the reference's pinned suite
+# (/root/reference/.github/workflows/cpu-tests.yaml:25-65 + tests/run_tests.py).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8"
+
+echo "=== stage 1/3: unit + E2E dry-run suite ==="
+python -m pytest tests/ -x -q --ignore=tests/test_regression
+
+echo "=== stage 2/3: numeric regression (goldens + reference fixture) ==="
+python -m pytest tests/test_regression -q
+
+echo "=== stage 3/3: multichip dryrun (virtual 8-device mesh) ==="
+python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "CI gate: ALL GREEN"

@@ -4,6 +4,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from sheeprl_tpu.algos.dreamer_v3.agent import RecurrentModel
 from sheeprl_tpu.ops.rssm_pallas import fused_rssm_recurrent
@@ -107,3 +108,63 @@ def test_tiled_rssm_forced_small():
     # 3H=1536 ⇒ three 512-wide column tiles; B=11, block_b=4 ⇒ padded batch tiles
     out = _pallas_forward_tiled(x, h0, *weights, block_b=4, interpret=True)
     np.testing.assert_allclose(np.asarray(out), ref, rtol=2e-5, atol=2e-5)
+
+
+def test_tiled_vmem_planner_fits_all_presets():
+    """ADVICE r3: the tiled path must account its VMEM working set.  Every
+    Dreamer preset (S..XL, reference agent.py world-model sizes) must admit
+    a legal tiling within the budget."""
+    from sheeprl_tpu.ops.rssm_pallas import (
+        _VMEM_WEIGHT_BUDGET_BYTES,
+        _plan_tiled,
+        _tiled_vmem_bytes,
+    )
+
+    # (dense_units D, recurrent H); ZA ~ stoch_flat + actions
+    presets = {"S": (512, 512), "M": (640, 1024), "L": (768, 2048), "XL": (1024, 4096)}
+    for name, (D, H) in presets.items():
+        ZA = 32 * 32 + 6
+        bt, tj = _plan_tiled(64, ZA, D, H, block_b=64)
+        assert (3 * H) % tj == 0, name
+        got = _tiled_vmem_bytes(bt, tj, ZA, D, H)
+        assert got <= _VMEM_WEIGHT_BUDGET_BYTES, (
+            f"{name}: planned tiling (bt={bt}, tj={tj}) still needs {got / 2**20:.1f} MiB"
+        )
+
+
+def test_tiled_vmem_planner_rejects_absurd_model():
+    from sheeprl_tpu.ops.rssm_pallas import _plan_tiled
+
+    with pytest.raises(ValueError, match="cannot fit VMEM"):
+        _plan_tiled(64, 65536, 32768, 32768, block_b=64)
+
+
+def test_tp_model_axis_rejects_pallas_rssm():
+    """TP column-shards w_gru; the pallas_call path must refuse loudly
+    (ADVICE r3) instead of silently all-gathering or failing in Mosaic."""
+    from gymnasium import spaces
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "algo=dreamer_v3_XS",
+            "fabric.devices=2",
+            "fabric.accelerator=cpu",
+            "algo.world_model.recurrent_model.fused_pallas=True",
+            "algo.cnn_keys.encoder=[]",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(
+        devices=2, accelerator="cpu", precision="32-true",
+        mesh_shape={"data": -1, "model": 2},
+    )
+    obs_space = spaces.Dict({"state": spaces.Box(-1, 1, (4,), np.float32)})
+    with pytest.raises(ValueError, match="cannot be[\\s\\S]*combined with the Pallas"):
+        build_agent(fabric, (4,), False, cfg, obs_space)

@@ -31,6 +31,14 @@ _ALGO_ARGS = {
         "algo.learning_starts=0",
         "algo.hidden_size=16",
     ],
+    # global-pool minibatching across processes (reference ppo.py:363-370)
+    "ppo_share_data": [
+        "exp=ppo",
+        "env.id=discrete_dummy",
+        "algo.rollout_steps=4",
+        "algo.update_epochs=2",
+        "buffer.share_data=True",
+    ],
     # dedicated cross-process player/trainer split: process 0 = envs-only
     # player, process 1 = trainer sub-mesh (reference decoupled topology,
     # sheeprl/algos/ppo/ppo_decoupled.py:623-670)
@@ -137,6 +145,7 @@ def _free_port() -> int:
     "algo",
     [
         "ppo",
+        "ppo_share_data",
         "sac",
         "dreamer_v3",
         "ppo_decoupled_dedicated",

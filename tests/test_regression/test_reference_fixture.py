@@ -1,0 +1,82 @@
+"""Cross-IMPLEMENTATION loss check (VERDICT r3 #4).
+
+`reference_fixture.json` holds one seeded batch and the loss values the
+REFERENCE implementation (torch, /root/reference/sheeprl/algos/dreamer_v3/
+loss.py:9-88) computed for it — regenerate with make_reference_fixture.py.
+Here the repo's jax implementation consumes the SAME batch and must land on
+the SAME numbers in fp32.  Unlike the self-captured goldens
+(test_golden.py), a pass here means the math agrees with an independent
+implementation, not merely with yesterday's self.
+
+Covers in one batch: MSE pixel reconstruction, symlog vector
+reconstruction, two-hot symlog reward NLL, Bernoulli continue NLL, and the
+free-nats-clipped balanced categorical KL.
+"""
+
+import json
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+FIXTURE_PATH = Path(__file__).parent / "reference_fixture.json"
+
+# fp32 accumulation-order slack between XLA and torch
+RTOL = 2e-5
+ATOL = 1e-6
+
+
+@pytest.fixture(scope="module")
+def fixture():
+    assert FIXTURE_PATH.exists(), "run make_reference_fixture.py (needs /root/reference)"
+    return json.loads(FIXTURE_PATH.read_text())
+
+
+def test_world_model_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
+    from sheeprl_tpu.utils.distribution import (
+        Bernoulli,
+        MSEDistribution,
+        SymlogDistribution,
+        TwoHotEncodingDistribution,
+    )
+
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in fixture["inputs"].items()}
+    meta = fixture["meta"]
+
+    obs_log_probs = {
+        "rgb": MSEDistribution(inp["cnn_recon"], event_dims=len(meta["shapes"]["cnn"])).log_prob(
+            inp["cnn_target"]
+        ),
+        "state": SymlogDistribution(inp["mlp_recon"], event_dims=1).log_prob(inp["mlp_target"]),
+    }
+    reward_lp = TwoHotEncodingDistribution(inp["reward_logits"], dims=1).log_prob(
+        inp["rewards"][..., None]
+    )
+    cont_lp = Bernoulli(inp["continue_logits"], event_dims=0).log_prob(1.0 - inp["terminated"])
+
+    total, aux = world_model_loss(
+        obs_log_probs,
+        reward_lp,
+        cont_lp,
+        inp["posterior_logits"],
+        inp["prior_logits"],
+        continue_scale_factor=meta["continue_scale_factor"],
+        **meta["kl_kwargs"],
+    )
+
+    expected = fixture["expected"]
+    got = {
+        "world_model_loss": float(total),
+        "kl": float(aux["kl"]),
+        "state_loss": float(aux["kl_loss"]),
+        "reward_loss": float(aux["reward_loss"]),
+        "observation_loss": float(aux["observation_loss"]),
+        "continue_loss": float(aux["continue_loss"]),
+    }
+    for name, want in expected.items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"{name}: repo={got[name]!r} reference={want!r} — the jax math "
+            "disagrees with the reference implementation on an identical batch"
+        )
