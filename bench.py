@@ -173,20 +173,27 @@ def bench_cpu_wall_clock(algo: str) -> dict:
 
     from sheeprl_tpu.cli import run
 
+    # BENCH_ARGS: extra CLI overrides, stamped into the metric name so a
+    # modified workload can never masquerade as the reference one
+    extra = os.environ.get("BENCH_ARGS", "").split()
     args = [
         f"exp={algo}_benchmarks",
         "print_config=False",
         "log_dir=/tmp/bench_logs",
+        *extra,
     ]
     t0 = time.perf_counter()
     run(args)
     elapsed = time.perf_counter() - t0
     ncpu = multiprocessing.cpu_count()
+    label = f" [{' '.join(extra)}]" if extra else ""
     return {
-        "metric": f"{algo}_benchmarks_65536_steps_wall_clock ({ncpu}-core host vs 4-CPU baseline)",
+        "metric": f"{algo}_benchmarks_65536_steps_wall_clock ({ncpu}-core host vs 4-CPU baseline){label}",
         "value": round(elapsed, 2),
         "unit": "s",
-        "vs_baseline": round(BASELINE_CPU_WALL_CLOCK_S[algo] / elapsed, 3),
+        # vs_baseline only for the untouched reference workload — a modified
+        # one gets the bracketed label and no numeric comparison
+        "vs_baseline": round(BASELINE_CPU_WALL_CLOCK_S[algo] / elapsed, 3) if not extra else None,
     }
 
 

@@ -41,7 +41,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, TrainWindow, save_configs
 
 
 @register_algorithm()
@@ -222,6 +222,10 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
+    window = TrainWindow(
+        cfg.algo.get("train_window_iters", 1),
+        pending=int(state.get("pending_gradient_steps", 0)) if state else 0,
+    )
     if state and "psync" in state:
         psync.load_state_dict(state["psync"])
 
@@ -294,12 +298,22 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 aggregator.update("Game/ep_len_avg", ep_len)
 
         # ---------------- training ------------------------------------------
+        # train_window_iters K > 1 accrues the Ratio-owed gradient steps over
+        # K env iterations and runs them as ONE scanned dispatch: identical
+        # update math and count, the per-dispatch fixed cost (host sample,
+        # transfer, launch — dominated by tunnel latency on a remote TPU)
+        # amortized K-fold.  Data staleness within a window is at most K-1
+        # env iterations — the same staleness class as the reference's
+        # decoupled trainer (reference: sheeprl/algos/sac/sac_decoupled.py).
+        # K = 1 (default) is the reference-coupled cadence, bit-for-bit.
         if update >= learning_starts:
-            per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
-            if per_rank_gradient_steps > 0:
+            due = window.push(
+                ratio(policy_step / fabric.world_size), update, learning_starts, total_iters
+            )
+            if due > 0:
                 with timer("Time/train_time"):
                     sample = rb.sample(
-                        batch_size, n_samples=per_rank_gradient_steps
+                        batch_size, n_samples=due
                     )  # (U, batch, *) block in one host call
                     batches = {
                         "obs": jnp.asarray(sample["obs"]),
@@ -317,7 +331,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                     params, opt_state, last_losses = train_phase(
                         params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                     )
-                    grad_step_counter += per_rank_gradient_steps
+                    grad_step_counter += due
                     player_params = psync.after_dispatch(params, player_params)
 
         # ---------------- logging -------------------------------------------
@@ -349,6 +363,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 "ratio": ratio.state_dict(),
                 "psync": psync.state_dict(),
                 "grad_steps": grad_step_counter,
+                "pending_gradient_steps": window.pending,
             }
             fabric.call(
                 "on_checkpoint_coupled",

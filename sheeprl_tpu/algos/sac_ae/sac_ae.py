@@ -38,7 +38,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, TrainWindow, save_configs
 
 
 def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array]:
@@ -269,6 +269,10 @@ def main(fabric: Any, cfg: Any) -> None:
     ratio = Ratio(cfg.algo.replay_ratio, pretrain_steps=cfg.algo.per_rank_pretrain_steps)
     if state and "ratio" in state:
         ratio.load_state_dict(state["ratio"])
+    window = TrainWindow(
+        cfg.algo.get("train_window_iters", 1),
+        pending=int(state.get("pending_gradient_steps", 0)) if state else 0,
+    )
     if state and "psync" in state:
         psync.load_state_dict(state["psync"])
 
@@ -329,7 +333,11 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Game/ep_len_avg", ep_len)
 
         if update >= learning_starts:
-            per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
+            # windowed multi-iteration dispatch, same contract as sac.py
+            # (algo.train_window_iters; update math/count unchanged)
+            per_rank_gradient_steps = window.push(
+                ratio(policy_step / fabric.world_size), update, learning_starts, total_iters
+            )
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
                     sample = rb.sample(batch_size, n_samples=per_rank_gradient_steps)
@@ -385,6 +393,7 @@ def main(fabric: Any, cfg: Any) -> None:
                 "ratio": ratio.state_dict(),
                 "psync": psync.state_dict(),
                 "grad_steps": grad_step_counter,
+                "pending_gradient_steps": window.pending,
             }
             fabric.call(
                 "on_checkpoint_coupled",

@@ -169,6 +169,32 @@ def safeatanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
 # replay-ratio governor
 # --------------------------------------------------------------------------
 
+class TrainWindow:
+    """Accrue ``Ratio``-owed gradient steps over K env iterations and release
+    them as ONE scanned dispatch (``algo.train_window_iters``).
+
+    Update math and count are exactly preserved — only the dispatch cadence
+    changes (data staleness within a window is at most K-1 iterations, the
+    reference's decoupled-trainer staleness class).  Shared by the SAC and
+    SAC-AE loops so the flush rule cannot drift between them.
+    """
+
+    def __init__(self, window_iters: int, pending: int = 0):
+        self.window_iters = max(int(window_iters), 1)
+        self.pending = int(pending)
+
+    def push(self, granted: int, update: int, learning_starts: int, total_iters: int) -> int:
+        """Add this iteration's granted steps; return the number to run NOW
+        (0 while the window is filling).  The last iteration always flushes
+        so no owed step is ever dropped."""
+        self.pending += int(granted)
+        window_full = (update - learning_starts) % self.window_iters == self.window_iters - 1
+        if self.pending > 0 and (window_full or update == total_iters):
+            out, self.pending = self.pending, 0
+            return out
+        return 0
+
+
 class Ratio:
     """Keeps gradient-steps : env-steps at a configured ratio.
 
