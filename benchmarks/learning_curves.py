@@ -98,13 +98,15 @@ def main(argv) -> int:
         }
         results[name] = summary
         print(f"[learning_curves] {name}: {summary}", flush=True)
+        # Persist after every workload: a multi-hour suite must not lose the
+        # index to a crash in a later workload.
+        index_path.write_text(json.dumps(results, indent=2, sort_keys=True) + "\n")
+        _write_index(results)
         try:
             check_workload(name, rewards, losses)
             print(f"[learning_curves] {name}: PASS", flush=True)
         except AssertionError as e:
             print(f"[learning_curves] {name}: FAIL — {e}", flush=True)
-    index_path.write_text(json.dumps(results, indent=2, sort_keys=True) + "\n")
-    _write_index(results)
     return 0
 
 

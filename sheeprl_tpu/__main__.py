@@ -1,8 +1,8 @@
 """``python -m sheeprl_tpu`` — training CLI.
 
 Subcommand-style flags mirror the reference's extra entry points
-(reference: pyproject.toml:57-61): ``--eval``, ``--register-model``,
-``--agents``.
+(reference: pyproject.toml:57-61): ``eval``/``--eval``,
+``register-model``/``--register-model``, ``agents``/``--agents``.
 """
 
 import sys
@@ -11,11 +11,12 @@ from sheeprl_tpu.cli import available_agents, evaluation, registration, run
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
-    if argv and argv[0] == "--eval":
+    cmd = argv[0].lstrip("-") if argv else ""
+    if cmd == "eval":
         evaluation(argv[1:])
-    elif argv and argv[0] == "--register-model":
+    elif cmd == "register-model":
         registration(argv[1:])
-    elif argv and argv[0] == "--agents":
+    elif cmd == "agents":
         available_agents()
     else:
         run(argv)

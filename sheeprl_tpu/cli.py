@@ -181,16 +181,15 @@ def evaluation(argv: Optional[List[str]] = None) -> None:
     with open(run_cfg_path) as f:
         cfg = dotdict(yaml.safe_load(f))
 
-    # eval runs single-device, 1 env, no video by default
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    apply_cli_overrides(cfg, rest)
+    # eval ALWAYS runs single-device, 1 env (reference: sheeprl/cli.py:202-268
+    # forces the same) — applied after the overrides so an env=<group> swap
+    # cannot resurrect the group's num_envs default
     cfg.fabric.devices = 1
     cfg.env.num_envs = 1
     cfg.env.capture_video = cfg.env.get("capture_video", False)
-    for ov in rest:
-        k, _, v = ov.partition("=")
-        from sheeprl_tpu.utils.structured import set_by_path
-        import yaml as _y
-
-        set_by_path(cfg, k.strip(), _y.safe_load(v))
 
     import sheeprl_tpu
     from sheeprl_tpu.parallel.fabric import build_fabric
@@ -225,6 +224,9 @@ def registration(argv: Optional[List[str]] = None) -> None:
 
     with open(ckpt_path.parent.parent / "config.yaml") as f:
         cfg = dotdict(yaml.safe_load(f))
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    apply_cli_overrides(cfg, [a for a in argv if not a.startswith("checkpoint_path=")])
     import importlib
 
     import sheeprl_tpu

@@ -77,9 +77,30 @@ def _find_config_file(rel: str, dirs: Sequence[Path]) -> Optional[Path]:
     return None
 
 
+class _ConfigLoader(yaml.SafeLoader):
+    """SafeLoader + YAML-1.2-style float resolution: PyYAML's 1.1 grammar
+    parses ``1e-3`` (no dot before the exponent) as a STRING, while Hydra/
+    OmegaConf — whose config surface this engine mirrors — parse it as a
+    float.  Config files full of ``lr: 1e-3`` must load as numbers."""
+
+
+_ConfigLoader.add_implicit_resolver(
+    "tag:yaml.org,2002:float",
+    re.compile(
+        r"""^(?:[-+]?(?:[0-9][0-9_]*)\.[0-9_]*(?:[eE][-+]?[0-9]+)?
+        |[-+]?(?:[0-9][0-9_]*)(?:[eE][-+]?[0-9]+)
+        |[-+]?\.[0-9_]+(?:[eE][-+]?[0-9]+)?
+        |[-+]?\.(?:inf|Inf|INF)
+        |\.(?:nan|NaN|NAN))$""",
+        re.X,
+    ),
+    list("-+0123456789."),
+)
+
+
 def _load_yaml(path: Path) -> Dict[str, Any]:
     with open(path, "r") as f:
-        data = yaml.safe_load(f)
+        data = yaml.load(f, Loader=_ConfigLoader)
     if data is None:
         return {}
     if not isinstance(data, dict):
@@ -100,7 +121,7 @@ def known_groups(dirs: Sequence[Path]) -> List[str]:
 
 def _parse_value(raw: str) -> Any:
     try:
-        return yaml.safe_load(raw)
+        return yaml.load(raw, Loader=_ConfigLoader)
     except yaml.YAMLError:
         return raw
 
@@ -172,26 +193,7 @@ def compose(
                     if g.startswith(prefix):
                         g = g[len(prefix):]
                 groups.add(g)
-    group_selection: Dict[str, Any] = {}
-    dot_overrides: List[Tuple[str, Any]] = []
-    placed_groups: List[Tuple[str, str, Any]] = []  # (target path, group, name)
-    for ov in overrides:
-        if "=" not in ov:
-            raise ConfigError(f"Override '{ov}' must look like key=value")
-        key, _, raw = ov.partition("=")
-        key = key.strip().lstrip("+")
-        value = _parse_value(raw.strip())
-        if "." not in key and key in groups:
-            group_selection[key] = value
-        elif "/" in key and key.rpartition("/")[2] in groups:
-            # "metric/logger=mlflow": swap the group instance PLACED at a
-            # nested path (the defaults-list "@" packaging, e.g.
-            # metric/default.yaml's "/logger@logger: tensorboard") from the
-            # CLI — hydra's `logger@metric.logger=mlflow` equivalent.
-            parent, _, grp = key.rpartition("/")
-            placed_groups.append((f"{parent.replace('/', '.')}.{grp}", grp, value))
-        else:
-            dot_overrides.append((key, value))
+    group_selection, placed_groups, dot_overrides = _classify_overrides(overrides, groups)
 
     cfg: Dict[str, Any] = {}
     exp_names: List[Any] = []
@@ -239,10 +241,7 @@ def compose(
         overlay = _load_yaml_exp(name, dirs, cfg, cli_groups)
         cfg = deep_merge(cfg, overlay)
 
-    for path, grp, name in placed_groups:
-        loaded = _load_group(grp, name, dirs)
-        loaded.pop("__root__", None)
-        set_by_path(cfg, path, loaded)
+    _apply_placed_groups(cfg, placed_groups, dirs)
 
     for key, value in dot_overrides:
         set_by_path(cfg, key, value)
@@ -251,6 +250,102 @@ def compose(
     if resolve:
         resolve_interpolations(out)
     return out
+
+
+def _apply_placed_groups(
+    tree: Dict[str, Any], placed_groups: List[Tuple[str, str, Any]], dirs: Sequence[Path]
+) -> None:
+    """Place group files at their dotted destinations (shared by compose and
+    apply_cli_overrides so eval-time replay cannot diverge from training)."""
+    for path, grp, name in placed_groups:
+        loaded = _load_group(grp, name, dirs)
+        loaded.pop("__root__", None)
+        set_by_path(tree, path, loaded)
+
+
+def _classify_overrides(
+    overrides: Sequence[str], groups: set
+) -> Tuple[Dict[str, Any], List[Tuple[str, str, Any]], List[Tuple[str, Any]]]:
+    """Split CLI overrides into (group selections, nested placed groups, dot
+    overrides) — the single source of truth for override syntax, shared by
+    :func:`compose` and :func:`apply_cli_overrides`.
+
+    ``parent/group=name`` (e.g. ``metric/logger=mlflow``) swaps the group
+    instance PLACED at a nested path (the defaults-list "@" packaging, e.g.
+    metric/default.yaml's ``/logger@logger: tensorboard``) — hydra's
+    ``logger@metric.logger=mlflow`` equivalent."""
+    group_selection: Dict[str, Any] = {}
+    placed_groups: List[Tuple[str, str, Any]] = []  # (target path, group, name)
+    dot_overrides: List[Tuple[str, Any]] = []
+    for ov in overrides:
+        if "=" not in ov:
+            raise ConfigError(f"Override '{ov}' must look like key=value")
+        key, _, raw = ov.partition("=")
+        key = key.strip().lstrip("+")
+        value = _parse_value(raw.strip())
+        if "." not in key and key in groups:
+            group_selection[key] = value
+        elif "@" in key and key.partition("@")[0] in groups and key.partition("@")[2]:
+            # hydra's full placement grammar, "optim@algo.world_model.optimizer=sgd":
+            # place group file optim/sgd.yaml AT the dotted destination path
+            grp, _, dest = key.partition("@")
+            placed_groups.append((dest, grp, value))
+        elif "/" in key and key.rpartition("/")[2] in groups:
+            parent, _, grp = key.rpartition("/")
+            placed_groups.append((f"{parent.replace('/', '.')}.{grp}", grp, value))
+        else:
+            dot_overrides.append((key, value))
+    return group_selection, placed_groups, dot_overrides
+
+
+def apply_cli_overrides(cfg: dotdict, overrides: Sequence[str]) -> None:
+    """Apply CLI-style overrides to an ALREADY-composed config tree with
+    compose's classification AND ordering: group re-selections first (each
+    REPLACES the old group instance, like a defaults-list re-select), then
+    nested placed groups, then ``a.b.c=value`` dot overrides last, then an
+    interpolation-resolution pass over the tree (freshly loaded group files
+    may carry ``${...}`` references; the rest of the tree is already
+    resolved, so the pass is a no-op elsewhere).
+
+    Used by the eval/registration dispatchers, which start from a saved run
+    config instead of the defaults tree (reference: sheeprl/cli.py:369-405
+    re-runs Hydra; here the saved config IS the tree, so only the override
+    step is replayed).  ``exp=`` overlays are rejected: an experiment picks
+    algorithms/environments, which cannot be swapped under a checkpoint."""
+    import copy
+
+    dirs = _search_dirs()
+    groups = set(known_groups(dirs))
+    group_selection, placed_groups, dot_overrides = _classify_overrides(overrides, groups)
+    if "exp" in group_selection:
+        raise ConfigError(
+            "exp=... cannot be applied on top of a saved run config; "
+            "override individual keys or groups instead"
+        )
+    for key, value in dot_overrides:
+        if "." not in key and isinstance(cfg.get(key), Mapping) and not isinstance(value, Mapping):
+            # compose() would have resolved this as a group selection (the
+            # group dir existed at train time, e.g. via SHEEPRL_SEARCH_PATH);
+            # silently replacing a whole section with a scalar corrupts the
+            # tree far from the error site — fail loudly instead.
+            raise ConfigError(
+                f"'{key}={value}' would replace the whole '{key}' config section "
+                f"with a scalar; '{key}' is not a known config group in "
+                f"{[str(d) for d in dirs]}"
+            )
+    # stage on a copy so a failing group load / interpolation leaves the
+    # caller's tree untouched — a caller catching ConfigError must not be
+    # left with a half-modified config
+    staged = copy.deepcopy(dict(cfg))
+    for group, name in group_selection.items():
+        staged.pop(group, None)
+        _merge_group_into(staged, group, name, dirs)
+    _apply_placed_groups(staged, placed_groups, dirs)
+    for key, value in dot_overrides:
+        set_by_path(staged, key, value)
+    staged = resolve_interpolations(dotdict(staged))
+    cfg.clear()
+    cfg.update(staged)
 
 
 def _load_yaml_exp(

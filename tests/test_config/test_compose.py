@@ -103,3 +103,90 @@ def test_nested_group_placement_cli(monkeypatch):
     assert cfg.metric.logger.tracking_uri == "http://tracking:5000"
     # the default instance is untouched without the override
     assert compose(["exp=ppo", "env.id=x"]).metric.logger.kind == "tensorboard"
+
+
+def test_apply_cli_overrides_on_saved_config():
+    """The eval/registration dispatchers replay CLI overrides onto a saved
+    run config; group syntax must behave like compose's (the eval path used
+    to set a literal "metric/logger" key, silently ignoring the override)."""
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    cfg = compose(base_overrides() + ["algo=ppo"])
+    assert cfg.metric.logger.kind == "tensorboard"
+    apply_cli_overrides(cfg, ["metric/logger=csv", "seed=7", "algo.gamma=0.5"])
+    assert cfg.metric.logger.kind == "csv"
+    assert cfg.seed == 7
+    assert cfg.algo.gamma == 0.5
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["not-an-override"])
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["exp=ppo"])
+
+
+def test_apply_cli_overrides_group_replaces_and_resolves():
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    cfg = compose(base_overrides() + ["algo=ppo", "env=minerl"])
+    assert "sticky_attack" in cfg.env.wrapper
+    apply_cli_overrides(cfg, ["env=dummy"])
+    # re-select REPLACES the instance: no minerl keys may leak into the
+    # dummy wrapper kwargs (they would become unexpected constructor args)
+    assert cfg.env.wrapper.kind == "dummy"
+    assert "sticky_attack" not in cfg.env.wrapper
+    # freshly loaded group files carry ${...} references which must resolve
+    # against the final tree, not survive as literal strings
+    import json
+
+    assert "${" not in json.dumps(cfg.as_dict())
+
+
+def test_apply_cli_overrides_ordering_matches_compose():
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    cfg = compose(base_overrides() + ["algo=ppo"])
+    # dot overrides are applied LAST regardless of CLI position, like compose
+    apply_cli_overrides(cfg, ["env.num_envs=1", "env=dummy"])
+    assert cfg.env.wrapper.kind == "dummy"
+    assert cfg.env.num_envs == 1
+
+
+def test_apply_cli_overrides_validates_before_mutating():
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    cfg = compose(base_overrides()[1:] + ["env=dummy", "algo=ppo"])
+    assert cfg.env.wrapper.kind == "dummy"
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["env=gym", "exp=ppo"])
+    assert cfg.env.wrapper.kind == "dummy"  # untouched on error
+
+    # a bare key naming a SECTION that is not a known group dir (e.g. the
+    # group came from SHEEPRL_SEARCH_PATH at train time but is absent now)
+    # must fail loudly, not silently replace the subtree with a scalar
+    cfg.mygroup = dotdict({"a": 1, "b": 2})
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["mygroup=name"])
+    assert cfg.mygroup.a == 1
+
+    # a group load failing MID-APPLY must also leave the tree untouched
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["env=this_env_does_not_exist"])
+    assert cfg.env.wrapper.kind == "dummy"
+    with pytest.raises(ConfigError):
+        apply_cli_overrides(cfg, ["metric/logger=typo_logger"])
+    assert cfg.metric.logger.kind == "tensorboard"
+
+
+def test_group_at_path_placement_grammar():
+    """hydra's `group@dot.path=name` CLI grammar (documented in
+    howto/run_experiments.md for optimizer swaps)."""
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    cfg = compose(base_overrides() + ["exp=dreamer_v3", "env=dummy"])
+    assert cfg.algo.world_model.optimizer.name == "adam"
+    cfg2 = compose(base_overrides() + ["exp=dreamer_v3", "env=dummy",
+                                       "optim@algo.world_model.optimizer=sgd"])
+    assert cfg2.algo.world_model.optimizer.name == "sgd"
+    assert cfg2.algo.world_model.optimizer.lr == 1e-2
+    # and on a saved config through the eval path
+    apply_cli_overrides(cfg, ["optim@algo.actor.optimizer=rmsprop"])
+    assert cfg.algo.actor.optimizer.name == "rmsprop"
