@@ -22,11 +22,17 @@ import time
 BASELINE_DV3_UPDATES_PER_S = 0.5   # RTX 3080, MsPacman-100K (BASELINE.md)
 
 # Reference v0.5.5 published wall-clocks, 4-CPU Lightning Studio host
-# (/root/reference/README.md:83-189): exp=<algo>_benchmarks, 65536 steps.
+# (/root/reference/README.md:83-189): exp=<algo>_benchmarks.  The `_wall`
+# dreamer targets run the reference's 16384-step tiny-model benchmark config
+# (the README "1 device" rows); on hosts without ALE the MsPacman env must be
+# swapped via BENCH_ARGS, which voids vs_baseline automatically.
 BASELINE_CPU_WALL_CLOCK_S = {
-    "ppo": 81.27,   # CartPole-v1, 1 env
-    "a2c": 84.76,   # CartPole-v1, 1 env
-    "sac": 320.21,  # LunarLanderContinuous, 4 envs
+    "ppo": 81.27,            # CartPole-v1, 1 env, 65536 steps
+    "a2c": 84.76,            # CartPole-v1, 1 env, 65536 steps
+    "sac": 320.21,           # LunarLanderContinuous, 4 envs, 65536 steps
+    "dreamer_v1_wall": 2207.13,  # MsPacman tiny model, 16384 steps
+    "dreamer_v2_wall": 906.42,
+    "dreamer_v3_wall": 1589.30,
 }
 
 
@@ -166,29 +172,36 @@ def _build_dv3_train_phase(fabric, cfg):
 
 def bench_cpu_wall_clock(algo: str) -> dict:
     """Run the EXACT reference benchmark workload (exp=<algo>_benchmarks —
-    same env, env count, rollout/batch shapes, 65536 total steps, logging and
-    test disabled) end-to-end and report wall-clock vs the reference's
-    published 4-CPU number (/root/reference/README.md:83-189)."""
+    same env, env count, rollout/batch shapes and step budget as the
+    reference's published run, logging and test disabled) end-to-end and
+    report wall-clock vs the reference's published 4-CPU number
+    (/root/reference/README.md:83-189: 65536 steps for ppo/a2c/sac, 16384
+    for the tiny-model dreamer rows)."""
     import multiprocessing
 
     from sheeprl_tpu.cli import run
+    from sheeprl_tpu.config.compose import compose
 
     # BENCH_ARGS: extra CLI overrides, stamped into the metric name so a
     # modified workload can never masquerade as the reference one
     extra = os.environ.get("BENCH_ARGS", "").split()
+    exp = algo.removesuffix("_wall")
     args = [
-        f"exp={algo}_benchmarks",
+        f"exp={exp}_benchmarks",
         "print_config=False",
         "log_dir=/tmp/bench_logs",
         *extra,
     ]
+    # the step count comes from the composed workload itself, never a
+    # hardcoded constant that could drift from the exp config
+    steps = int(compose(args).algo.total_steps)
     t0 = time.perf_counter()
     run(args)
     elapsed = time.perf_counter() - t0
     ncpu = multiprocessing.cpu_count()
     label = f" [{' '.join(extra)}]" if extra else ""
     return {
-        "metric": f"{algo}_benchmarks_65536_steps_wall_clock ({ncpu}-core host vs 4-CPU baseline){label}",
+        "metric": f"{exp}_benchmarks_{steps}_steps_wall_clock ({ncpu}-core host vs 4-CPU baseline){label}",
         "value": round(elapsed, 2),
         "unit": "s",
         # vs_baseline only for the untouched reference workload — a modified
@@ -250,7 +263,13 @@ def _watchdog_main() -> None:
             result = {"metric": "bench_failed", "value": 0, "unit": "", "vs_baseline": None}
         print(json.dumps(result))
 
-    timeout_s = int(os.environ.get("BENCH_TIMEOUT", 1200))
+    # default timeout must comfortably cover the workload: the dreamer _wall
+    # baselines alone are 1589-2207s on the reference's 4-CPU host
+    target = os.environ.get("BENCH_TARGET")
+    default_timeout = 1200
+    if target in BASELINE_CPU_WALL_CLOCK_S:
+        default_timeout = max(1200, int(4 * BASELINE_CPU_WALL_CLOCK_S[target]))
+    timeout_s = int(os.environ.get("BENCH_TIMEOUT", default_timeout))
     env = {**os.environ, "BENCH_CHILD": "1"}
     if os.environ.get("BENCH_TARGET") in BASELINE_CPU_WALL_CLOCK_S:
         # CPU wall-clock benchmarks are CPU by definition (the baseline is the
