@@ -19,4 +19,6 @@ def policy_loss(logprobs: jax.Array, advantages: jax.Array, reduction: str = "su
 
 
 def value_loss(values: jax.Array, returns: jax.Array, reduction: str = "sum") -> jax.Array:
-    return _reduce(0.5 * (values - returns) ** 2, reduction)
+    # plain mse, matching the reference scale (the reference's A2C reuses the
+    # PPO value_loss: sheeprl/algos/a2c/a2c.py:15 → ppo/loss.py:45-55)
+    return _reduce((values - returns) ** 2, reduction)

@@ -19,8 +19,9 @@ import jax.numpy as jnp
 import optax
 
 from sheeprl_tpu.algos.dreamer_v1.agent import GaussianWorldModel, build_agent
+from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_obs_block
-from sheeprl_tpu.utils.distribution import Bernoulli, Normal, kl_normal
+from sheeprl_tpu.utils.distribution import Bernoulli, Normal
 from sheeprl_tpu.utils.registry import register_algorithm
 
 
@@ -92,24 +93,15 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
                 (1.0 - data["terminated"]) * gamma
             )
         else:
-            continue_loss = jnp.zeros_like(reward_loss)
+            continue_loss = None
 
         post_mean, post_std = jnp.split(post_m, 2, -1)
         prior_mean, prior_std = jnp.split(prior_m, 2, -1)
-        kl = kl_normal(
-            Normal(post_mean, post_std, event_dims=1), Normal(prior_mean, prior_std, event_dims=1)
+        total, aux = reconstruction_loss(
+            obs_loss, reward_loss, continue_loss, post_mean, post_std, prior_mean, prior_std,
+            kl_free_nats=kl_free_nats, kl_regularizer=kl_regularizer,
         )
-        state_loss = jnp.maximum(kl.mean(), kl_free_nats)
-
-        total = kl_regularizer * state_loss + (obs_loss + reward_loss + continue_loss).mean()
-        aux = {
-            "latents": latents,
-            "kl": kl.mean(),
-            "kl_loss": state_loss,
-            "observation_loss": obs_loss.mean(),
-            "reward_loss": reward_loss.mean(),
-            "continue_loss": continue_loss.mean(),
-        }
+        aux["latents"] = latents
         return total, aux
 
     def behavior_update(p, o_state, latents, terminated, k,

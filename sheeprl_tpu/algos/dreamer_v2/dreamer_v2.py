@@ -27,7 +27,8 @@ import optax
 
 from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel
 from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_obs_block
-from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical, kl_categorical
+from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical
 from sheeprl_tpu.utils.registry import register_algorithm
 
 
@@ -172,31 +173,15 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
             pc = Bernoulli(cont_logits.reshape(L, B))
             continue_loss = -discount_scale * pc.log_prob((1.0 - data["terminated"]) * gamma)
         else:
-            continue_loss = jnp.zeros_like(reward_loss)
+            continue_loss = None
 
-        # α-balanced KL with free-avg (reference: dreamer_v2/loss.py:60-79)
-        post = OneHotCategorical(post_logits)
-        post_sg = OneHotCategorical(jax.lax.stop_gradient(post_logits))
-        prior = OneHotCategorical(prior_logits)
-        prior_sg = OneHotCategorical(jax.lax.stop_gradient(prior_logits))
-        lhs = kl_categorical(post_sg, prior).sum(-1)
-        rhs = kl_categorical(post, prior_sg).sum(-1)
-        kl = lhs
-        loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
-        loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
-        kl_loss = kl_alpha * loss_lhs + (1 - kl_alpha) * loss_rhs
-
-        total = kl_regularizer * kl_loss + (obs_loss + reward_loss + continue_loss).mean()
-        aux = {
-            "latents": latents,
-            "post_logits": post_logits,
-            "prior_logits": prior_logits,
-            "kl": kl.mean(),
-            "kl_loss": kl_loss,
-            "observation_loss": obs_loss.mean(),
-            "reward_loss": reward_loss.mean(),
-            "continue_loss": continue_loss.mean(),
-        }
+        total, aux = reconstruction_loss(
+            obs_loss, reward_loss, continue_loss, post_logits, prior_logits,
+            kl_balancing_alpha=kl_alpha, kl_free_nats=kl_free_nats, kl_regularizer=kl_regularizer,
+        )
+        aux["latents"] = latents
+        aux["post_logits"] = post_logits
+        aux["prior_logits"] = prior_logits
         return total, aux
 
     def behavior_update(p, o_state, latents, terminated, k, actor_key="actor",

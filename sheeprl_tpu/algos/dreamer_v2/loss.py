@@ -1,0 +1,50 @@
+"""DreamerV2 world-model loss, pure jittable math
+(reference: sheeprl/algos/dreamer_v2/loss.py:9-85): α-balanced categorical
+KL with free-avg free nats, Gaussian unit-variance reconstruction NLLs."""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.utils.distribution import OneHotCategorical, kl_categorical
+
+
+def reconstruction_loss(
+    obs_nll: jax.Array,
+    reward_nll: jax.Array,
+    continue_nll: Optional[jax.Array],
+    posteriors_logits: jax.Array,
+    priors_logits: jax.Array,
+    kl_balancing_alpha: float = 0.8,
+    kl_free_nats: float = 0.0,
+    kl_regularizer: float = 1.0,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """``obs_nll``/``reward_nll``/``continue_nll`` are per-step negative
+    log-likelihoods of shape (L, B) (``continue_nll`` already scaled by the
+    discount scale factor, or None when the continue head is disabled);
+    posterior/prior logits are (L, B, stochastic, discrete)."""
+    if continue_nll is None:
+        continue_nll = jnp.zeros_like(reward_nll)
+    post = OneHotCategorical(posteriors_logits)
+    post_sg = OneHotCategorical(jax.lax.stop_gradient(posteriors_logits))
+    prior = OneHotCategorical(priors_logits)
+    prior_sg = OneHotCategorical(jax.lax.stop_gradient(priors_logits))
+    # KL balancing (free-avg): each side clipped AFTER averaging
+    lhs = kl_categorical(post_sg, prior).sum(-1)
+    rhs = kl_categorical(post, prior_sg).sum(-1)
+    kl = lhs
+    loss_lhs = jnp.maximum(lhs.mean(), kl_free_nats)
+    loss_rhs = jnp.maximum(rhs.mean(), kl_free_nats)
+    kl_loss = kl_balancing_alpha * loss_lhs + (1 - kl_balancing_alpha) * loss_rhs
+    total = kl_regularizer * kl_loss + (obs_nll + reward_nll + continue_nll).mean()
+    aux = {
+        "kl": kl.mean(),
+        "kl_loss": kl_loss,
+        "observation_loss": obs_nll.mean(),
+        "reward_loss": reward_nll.mean(),
+        "continue_loss": continue_nll.mean(),
+    }
+    return total, aux

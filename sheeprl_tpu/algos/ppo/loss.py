@@ -37,11 +37,16 @@ def value_loss(
     clip_vloss: bool,
     reduction: str = "mean",
 ) -> jax.Array:
+    # scale parity with the reference (reference: sheeprl/algos/ppo/loss.py:45-61):
+    # the unclipped branch is a PLAIN mse (no 0.5) honoring `reduction`; the
+    # clipped branch is ALWAYS 0.5·mean(max(unclipped, clipped)) — the
+    # reference ignores `reduction` there, and users porting reference
+    # configs rely on the effective vf_coef scale matching exactly
     if not clip_vloss:
-        return _reduce(0.5 * (new_values - returns) ** 2, reduction)
+        return _reduce((new_values - returns) ** 2, reduction)
     v_clipped = old_values + jnp.clip(new_values - old_values, -clip_coef, clip_coef)
     losses = jnp.maximum((new_values - returns) ** 2, (v_clipped - returns) ** 2)
-    return _reduce(0.5 * losses, reduction)
+    return 0.5 * losses.mean()
 
 
 def entropy_loss(entropy: jax.Array, reduction: str = "mean") -> jax.Array:

@@ -6,8 +6,10 @@ import jax
 
 
 def critic_loss(qs: jax.Array, target: jax.Array) -> jax.Array:
-    """Sum of per-critic MSEs; ``qs`` is (N, B), ``target`` (B,)."""
-    return 0.5 * ((qs - target[None, :]) ** 2).mean(axis=1).sum()
+    """Sum of per-critic MSEs; ``qs`` is (N, B), ``target`` (B,).  Plain mse
+    per critic (no 0.5), matching the reference scale
+    (reference: sheeprl/algos/sac/loss.py:15-20)."""
+    return ((qs - target[None, :]) ** 2).mean(axis=1).sum()
 
 
 def actor_loss(alpha: jax.Array, log_prob: jax.Array, min_q: jax.Array) -> jax.Array:

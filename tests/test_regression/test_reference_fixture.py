@@ -80,3 +80,130 @@ def test_world_model_losses_match_reference(fixture):
             f"{name}: repo={got[name]!r} reference={want!r} — the jax math "
             "disagrees with the reference implementation on an identical batch"
         )
+
+
+def test_ppo_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
+
+    sec = fixture["ppo"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    clip = sec["clip_coef"]
+    got = {
+        "policy_loss": float(policy_loss(inp["new_logprobs"], inp["old_logprobs"], inp["advantages"], clip)),
+        "value_loss_unclipped": float(
+            value_loss(inp["new_values"], inp["old_values"], inp["returns"], clip, False)
+        ),
+        "value_loss_clipped": float(
+            value_loss(inp["new_values"], inp["old_values"], inp["returns"], clip, True)
+        ),
+        # the reference IGNORES `reduction` in the clipped branch — ours must too
+        "value_loss_clipped_sum_reduction": float(
+            value_loss(inp["new_values"], inp["old_values"], inp["returns"], clip, True, "sum")
+        ),
+        "entropy_loss": float(entropy_loss(inp["entropy"])),
+    }
+    assert got.pop("value_loss_clipped_sum_reduction") == pytest.approx(
+        sec["expected"]["value_loss_clipped"], rel=RTOL, abs=ATOL
+    )
+    for name, want in sec["expected"].items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"ppo {name}: repo={got[name]!r} reference={want!r}"
+        )
+
+
+def test_sac_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
+
+    sec = fixture["sac"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    # reference layouts: qf_values (B, N), next_qf_value/logprobs/min_q (B, 1);
+    # ours: qs (N, B), target/log_prob/min_q (B,)
+    got = {
+        "critic_loss": float(critic_loss(inp["qf_values"].T, inp["next_qf_value"][:, 0])),
+        "policy_loss": float(actor_loss(sec["alpha"], inp["logprobs"][:, 0], inp["min_q"][:, 0])),
+        "entropy_loss": float(
+            alpha_loss(jnp.asarray(sec["log_alpha"]), inp["logprobs"][:, 0], sec["target_entropy"])
+        ),
+    }
+    for name, want in sec["expected"].items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"sac {name}: repo={got[name]!r} reference={want!r}"
+        )
+
+
+def test_a2c_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.a2c.loss import policy_loss, value_loss
+
+    sec = fixture["a2c"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    got = {
+        "policy_loss_sum": float(policy_loss(inp["logprobs"], inp["advantages"], "sum")),
+        "policy_loss_mean": float(policy_loss(inp["logprobs"], inp["advantages"], "mean")),
+        "value_loss_sum": float(value_loss(inp["values"], inp["returns"], "sum")),
+    }
+    for name, want in sec["expected"].items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"a2c {name}: repo={got[name]!r} reference={want!r}"
+        )
+
+
+def test_dv1_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.dreamer_v1.loss import reconstruction_loss
+    from sheeprl_tpu.utils.distribution import Normal
+
+    sec = fixture["dreamer_v1"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    obs_nll = -(
+        Normal(inp["cnn_recon"], 1.0, event_dims=3).log_prob(inp["cnn_target"])
+        + Normal(inp["mlp_recon"], 1.0, event_dims=1).log_prob(inp["mlp_target"])
+    )
+    reward_nll = -Normal(inp["reward_mean"], 1.0).log_prob(inp["rewards"])
+    total, aux = reconstruction_loss(
+        obs_nll, reward_nll, None,
+        inp["post_mean"], inp["post_std"], inp["prior_mean"], inp["prior_std"],
+        kl_free_nats=sec["kl_free_nats"], kl_regularizer=sec["kl_regularizer"],
+    )
+    got = {
+        "reconstruction_loss": float(total),
+        "kl": float(aux["kl"]),
+        "state_loss": float(aux["kl_loss"]),
+        "reward_loss": float(aux["reward_loss"]),
+        "observation_loss": float(aux["observation_loss"]),
+    }
+    for name, want in sec["expected"].items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"dv1 {name}: repo={got[name]!r} reference={want!r}"
+        )
+
+
+def test_dv2_losses_match_reference(fixture):
+    from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+    from sheeprl_tpu.utils.distribution import Bernoulli, Normal
+
+    sec = fixture["dreamer_v2"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    obs_nll = -(
+        Normal(inp["cnn_recon"], 1.0, event_dims=3).log_prob(inp["cnn_target"])
+        + Normal(inp["mlp_recon"], 1.0, event_dims=1).log_prob(inp["mlp_target"])
+    )
+    reward_nll = -Normal(inp["reward_mean"], 1.0).log_prob(inp["rewards"])
+    continue_nll = -sec["discount_scale_factor"] * Bernoulli(inp["continue_logits"]).log_prob(
+        (1.0 - inp["terminated"]) * sec["gamma"]
+    )
+    total, aux = reconstruction_loss(
+        obs_nll, reward_nll, continue_nll, inp["posterior_logits"], inp["prior_logits"],
+        kl_balancing_alpha=sec["kl_balancing_alpha"],
+        kl_free_nats=sec["kl_free_nats"], kl_regularizer=sec["kl_regularizer"],
+    )
+    got = {
+        "reconstruction_loss": float(total),
+        "kl": float(aux["kl"]),
+        "kl_loss": float(aux["kl_loss"]),
+        "reward_loss": float(aux["reward_loss"]),
+        "observation_loss": float(aux["observation_loss"]),
+        "continue_loss": float(aux["continue_loss"]),
+    }
+    for name, want in sec["expected"].items():
+        assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
+            f"dv2 {name}: repo={got[name]!r} reference={want!r}"
+        )
