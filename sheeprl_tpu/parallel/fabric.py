@@ -540,6 +540,14 @@ def _u8_to_obj(arr: np.ndarray) -> Any:
 def build_fabric(cfg: Any) -> Fabric:
     """Instantiate the runtime from ``cfg.fabric`` (+ register callbacks)."""
     fab_cfg = cfg.fabric
+    cache_dir = fab_cfg.get("compilation_cache_dir")
+    if cache_dir:
+        # persistent XLA compilation cache: the 20-40s first compile of a
+        # Dreamer train window is paid once per (program, jaxlib, topology),
+        # not once per process — essential for short driver/bench runs.
+        # (The min-compile-time threshold is left at JAX's default so the
+        # JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS env override is honored.)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
     fabric = Fabric(
         devices=fab_cfg.get("devices", 1),
         num_nodes=fab_cfg.get("num_nodes", 1),

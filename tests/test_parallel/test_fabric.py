@@ -186,3 +186,34 @@ def test_env_sharding_plan():
     with mock.patch("jax.process_count", return_value=2):
         with pytest.raises(ValueError, match="divisible"):
             fab.env_sharding_plan(3, "PPO")
+
+
+def test_compilation_cache_dir_config(tmp_path):
+    """fabric.compilation_cache_dir wires the persistent XLA compilation
+    cache; entries appear for newly compiled programs."""
+    import glob
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    cfg = compose(
+        [
+            "env=dummy", "env.id=discrete_dummy", "algo=ppo",
+            "algo.total_steps=1", "algo.per_rank_batch_size=1",
+            f"fabric.compilation_cache_dir={tmp_path}", "fabric.accelerator=cpu",
+        ]
+    )
+    orig_dir = jax.config.jax_compilation_cache_dir
+    orig_min = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        build_fabric(cfg)
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.jit(lambda x: (x @ x.T).sum() + 41)(jnp.ones((64, 64))).block_until_ready()
+        assert glob.glob(str(tmp_path) + "/*"), "no cache entries written"
+    finally:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", orig_min)
+        jax.config.update("jax_compilation_cache_dir", orig_dir)
