@@ -28,6 +28,7 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import Actor, Critic, WorldModel
 from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_obs_block
 from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
+from sheeprl_tpu.algos.p2e_utils import ensemble_disagreement
 from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical
 from sheeprl_tpu.utils.registry import register_algorithm
 
@@ -216,9 +217,8 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
                         jnp.concatenate([traj, actions_seq], -1)
                     ).reshape((horizon + 1) * n, -1),
                 )
-                rewards = (
-                    preds.reshape(p2e["n"], horizon + 1, n, -1).var(0).mean(-1)
-                    * p2e["multiplier"]
+                rewards = ensemble_disagreement(
+                    preds.reshape(p2e["n"], horizon + 1, n, -1), p2e["multiplier"]
                 )
             else:
                 rewards = world_model.apply(

@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.dreamer_v3.agent import (
     build_agent as dv3_build_agent,
 )
 from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
+from sheeprl_tpu.algos.p2e_utils import ensemble_disagreement
 from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_obs_block, moments_update
 from sheeprl_tpu.utils.distribution import Bernoulli, OneHotCategorical, TwoHotEncodingDistribution
 from sheeprl_tpu.utils.optim import build_optimizer
@@ -230,7 +231,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
             )
             preds = ens.apply(p["ensembles"], ens_in.reshape((horizon + 1) * n, -1))
             preds = preds.reshape(int(cfg.algo.ensembles.n), horizon + 1, n, stoch_flat)
-            intrinsic = preds.var(0).mean(-1) * intrinsic_mult  # (H+1, n)
+            intrinsic = ensemble_disagreement(preds, intrinsic_mult)  # (H+1, n)
 
             advantage = 0.0
             aux_per_critic = {}

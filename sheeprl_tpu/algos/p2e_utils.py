@@ -45,3 +45,15 @@ def project_exploration_state(
     if "rb" in state:
         out["rb"] = state["rb"]
     return out
+
+
+def ensemble_disagreement(preds, multiplier: float):
+    """Plan2Explore intrinsic reward: UNBIASED variance of the ensemble's
+    next-state predictions, averaged over the feature dim
+    (reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:283 —
+    ``next_state_embedding.var(0).mean(-1) * multiplier``; torch's ``var``
+    uses the N-1 divisor, hence ddof=1).
+
+    ``preds``: (n_ensembles, ..., feature_dim).
+    """
+    return preds.var(0, ddof=1).mean(-1) * multiplier

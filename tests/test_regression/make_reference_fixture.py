@@ -302,6 +302,25 @@ def make_dv2_section() -> dict:
     }
 
 
+def make_p2e_section() -> dict:
+    """Plan2Explore intrinsic reward through the reference expression
+    (reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:283 —
+    ``next_state_embedding.var(0).mean(-1) * multiplier``; torch's ``var``
+    is UNBIASED (N-1), which jnp.var is not by default)."""
+    import torch
+
+    rng = np.random.default_rng(23)
+    n_ens, H, n, D = 5, 4, 6, 8
+    preds = rng.normal(0, 1.0, (n_ens, H, n, D)).astype(np.float32)
+    mult = 0.5
+    expected = (torch.from_numpy(preds).var(0).mean(-1) * mult).numpy()
+    return {
+        "inputs": {"preds": preds.tolist()},
+        "multiplier": mult,
+        "expected": {"intrinsic_reward": expected.tolist()},
+    }
+
+
 def main() -> None:
     import torch
     from torch.distributions import Independent
@@ -340,6 +359,7 @@ def main() -> None:
         "a2c": make_a2c_section(),
         "dreamer_v1": make_dv1_section(),
         "dreamer_v2": make_dv2_section(),
+        "p2e": make_p2e_section(),
         "meta": {
             "source": "sheeprl/algos/dreamer_v3/loss.py:9-88 (reference implementation)",
             "shapes": {"T": T, "B": B, "cnn": CNN_SHAPE, "mlp": MLP_DIM,

@@ -207,3 +207,16 @@ def test_dv2_losses_match_reference(fixture):
         assert got[name] == pytest.approx(want, rel=RTOL, abs=ATOL), (
             f"dv2 {name}: repo={got[name]!r} reference={want!r}"
         )
+
+
+def test_p2e_intrinsic_reward_matches_reference(fixture):
+    """The ensemble-disagreement intrinsic reward uses torch's UNBIASED
+    variance in the reference — jnp.var needs ddof=1 to match (the
+    mismatch is an N/(N-1) scale error on every intrinsic reward)."""
+    from sheeprl_tpu.algos.p2e_utils import ensemble_disagreement
+
+    sec = fixture["p2e"]
+    preds = jnp.asarray(np.asarray(sec["inputs"]["preds"], np.float32))
+    got = ensemble_disagreement(preds, sec["multiplier"])
+    want = np.asarray(sec["expected"]["intrinsic_reward"], np.float32)
+    np.testing.assert_allclose(np.asarray(got), want, rtol=RTOL, atol=ATOL)
