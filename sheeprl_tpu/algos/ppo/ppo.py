@@ -52,7 +52,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
-from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def epoch_permutation(
@@ -174,7 +174,7 @@ def main(fabric: Any, cfg: Any) -> None:
         new_logprobs, entropy = evaluate_actions(out, batch["actions"], actions_dim, is_continuous, dist_type=dist_type)
         adv = batch["advantages"]
         if normalize_adv:
-            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            adv = normalize_tensor(adv)
         pg = policy_loss(new_logprobs, batch["logprobs"], adv, clip_coef, reduction)
         vl = value_loss(new_values[..., 0], batch["values"], batch["returns"], clip_coef, clip_vloss, reduction)
         ent = entropy_loss(entropy, reduction)

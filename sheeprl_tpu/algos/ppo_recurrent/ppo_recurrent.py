@@ -42,7 +42,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
 
 
 def _dist_stats(actor_out, actions, actions_dim, is_continuous):
@@ -185,7 +185,7 @@ def main(fabric: Any, cfg: Any) -> None:
                     lp, ent = _dist_stats(a_out, acts, actions_dim, is_continuous)
                     adv = jnp.take(advantages, env_idx, axis=1)
                     if normalize_adv:
-                        adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+                        adv = normalize_tensor(adv)
                     old_lp = jnp.take(rollout["logprobs"], env_idx, axis=1)
                     ret = jnp.take(returns, env_idx, axis=1)
                     old_v = jnp.take(values, env_idx, axis=1)

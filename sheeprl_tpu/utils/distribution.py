@@ -319,13 +319,21 @@ class TwoHotEncodingDistribution:
     def _two_hot(self, x: jax.Array) -> jax.Array:
         n = self.bins.shape[0]
         x = symlog(x)
+        # saturate outside the support: the reference derives `above` from the
+        # UNCLAMPED below so out-of-range values degenerate to one bucket
+        # (reference: distribution.py:256-266); clipping x is equivalent
+        x = jnp.clip(x, self.bins[0], self.bins[-1])
         below = jnp.sum((self.bins <= x).astype(jnp.int32), axis=-1) - 1
         below = jnp.clip(below, 0, n - 1)
         above = jnp.clip(below + 1, 0, n - 1)
         x0 = jnp.squeeze(x, -1)
-        d_below = jnp.abs(self.bins[below] - x0)
-        d_above = jnp.abs(self.bins[above] - x0)
-        total = jnp.where(d_below + d_above == 0, 1.0, d_below + d_above)
+        # reference's `equal` branch (distribution.py:264-266): at the
+        # saturated top bucket below==above and both distances are 0 — force
+        # them to 1 so the weights sum to 1 on that bucket, not 0
+        equal = below == above
+        d_below = jnp.where(equal, 1.0, jnp.abs(self.bins[below] - x0))
+        d_above = jnp.where(equal, 1.0, jnp.abs(self.bins[above] - x0))
+        total = d_below + d_above
         w_below = d_above / total
         w_above = d_below / total
         return (

@@ -108,15 +108,21 @@ def two_hot_encoder(x: jax.Array, support_range: int = 300, num_buckets: Optiona
     if num_buckets is None:
         num_buckets = int(2 * support_range + 1)
     x = symlog(x)
+    # clip INTO the support (reference: sheeprl/utils/utils.py:176 clips the
+    # tensor): without it a value below -support splits weight between the
+    # first two buckets instead of saturating the first
+    x = jnp.clip(x, -support_range, support_range)
     buckets = jnp.linspace(-support_range, support_range, num_buckets, dtype=x.dtype)
     below = jnp.sum((buckets <= x).astype(jnp.int32), axis=-1) - 1
     below = jnp.clip(below, 0, num_buckets - 1)
     above = jnp.clip(below + 1, 0, num_buckets - 1)
     x0 = jnp.squeeze(x, -1)
-    dist_below = jnp.abs(buckets[below] - x0)
-    dist_above = jnp.abs(buckets[above] - x0)
+    # below==above at the saturated top bucket: both distances are 0 there,
+    # so force them to 1 (reference's `equal` branch) → 0.5+0.5 on one bucket
+    equal = below == above
+    dist_below = jnp.where(equal, 1.0, jnp.abs(buckets[below] - x0))
+    dist_above = jnp.where(equal, 1.0, jnp.abs(buckets[above] - x0))
     total = dist_below + dist_above
-    total = jnp.where(total == 0, 1.0, total)
     w_below = dist_above / total
     w_above = dist_below / total
     enc = (
@@ -139,7 +145,8 @@ def two_hot_decoder(probs: jax.Array, support_range: int = 300) -> jax.Array:
 # --------------------------------------------------------------------------
 
 def normalize_tensor(x: jax.Array, eps: float = 1e-8) -> jax.Array:
-    return (x - x.mean()) / (x.std() + eps)
+    # ddof=1: torch.std is unbiased (reference: sheeprl/utils/utils.py:126)
+    return (x - x.mean()) / (x.std(ddof=1) + eps)
 
 
 def polynomial_decay(

@@ -302,6 +302,97 @@ def make_dv2_section() -> dict:
     }
 
 
+def load_ref_functions(rel: str, names: tuple, extra_ns: dict) -> dict:
+    """Compile ONLY the named top-level functions out of a reference file —
+    sidesteps module-level imports (lightning, omegaconf, rich) this image
+    lacks.  The functions' own bodies use only what ``extra_ns`` provides."""
+    import ast
+
+    src = (REFERENCE / rel).read_text()
+    tree = ast.parse(src)
+    wanted = [n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name in names]
+    assert len(wanted) == len(names), f"missing functions in {rel}"
+    ns = dict(extra_ns)
+    for node in wanted:
+        node.decorator_list = []  # e.g. @torch.no_grad()
+        mod = ast.Module(body=[node], type_ignores=[])
+        exec(compile(ast.fix_missing_locations(mod), rel, "exec"), ns)
+    return {n: ns[n] for n in names}
+
+
+def make_math_section() -> dict:
+    """Core math utilities through the reference: GAE
+    (reference: sheeprl/utils/utils.py:63-100), TD(λ)
+    (reference: sheeprl/algos/dreamer_v3/utils.py:66-77), the two-hot
+    codec (reference: sheeprl/utils/utils.py:156-205), and three steps of
+    TF-style RMSprop (reference: sheeprl/optim/rmsprop_tf.py)."""
+    import torch
+    from typing import Optional, Tuple
+
+    ns = {"torch": torch, "Tensor": torch.Tensor, "Optional": Optional, "Tuple": Tuple}
+    fns = load_ref_functions(
+        "sheeprl/utils/utils.py", ("gae", "two_hot_encoder", "two_hot_decoder"), ns
+    )
+    lam = load_ref_functions(
+        "sheeprl/algos/dreamer_v3/utils.py", ("compute_lambda_values",), ns
+    )["compute_lambda_values"]
+
+    rng = np.random.default_rng(29)
+    Tn, Bn = 7, 3
+    f32 = lambda a: a.astype(np.float32)
+    inp = {
+        "rewards": f32(rng.normal(0, 1.0, (Tn, Bn))),
+        "values": f32(rng.normal(0, 1.0, (Tn, Bn))),
+        "dones": f32(rng.integers(0, 2, (Tn, Bn))),
+        "next_value": f32(rng.normal(0, 1.0, (1, Bn))),
+        "lam_rewards": f32(rng.normal(0, 1.0, (Tn, Bn, 1))),
+        "lam_values": f32(rng.normal(0, 1.0, (Tn, Bn, 1))),
+        "lam_continues": f32(rng.uniform(0, 1, (Tn, Bn, 1))),
+        "two_hot_x": f32(rng.uniform(-19.0, 19.0, (Bn, 1))),
+        "two_hot_probs": f32(rng.dirichlet(np.ones(11), Bn)),
+        "opt_param": f32(rng.normal(0, 1.0, (4, 3))),
+        "opt_grads": f32(rng.normal(0, 0.5, (3, 4, 3))),
+    }
+    t = {k: torch.from_numpy(v) for k, v in inp.items()}
+    gamma, gae_lambda, lmbda = 0.99, 0.95, 0.95
+    returns, advantages = fns["gae"](
+        t["rewards"], t["values"], t["dones"].bool(), t["next_value"], Tn, gamma, gae_lambda
+    )
+    lambda_values = lam(t["lam_rewards"], t["lam_values"], t["lam_continues"], lmbda)
+    support, buckets = 5, 11
+    encoded = fns["two_hot_encoder"](t["two_hot_x"], support, buckets)
+    decoded = fns["two_hot_decoder"](t["two_hot_probs"], support)
+
+    # 3 RMSpropTF steps on a seeded param with momentum (constant lr; the
+    # reference's lr_in_momentum only differs under a mid-run lr change)
+    rmsprop_mod = load_ref_module("ref_rmsprop_tf", "sheeprl/optim/rmsprop_tf.py")
+    lr, alpha, eps, momentum = 0.05, 0.9, 1e-10, 0.9
+    p = torch.nn.Parameter(t["opt_param"].clone())
+    opt = rmsprop_mod.RMSpropTF([p], lr=lr, alpha=alpha, eps=eps, momentum=momentum)
+    for i in range(3):
+        opt.zero_grad()
+        p.grad = t["opt_grads"][i].clone()
+        opt.step()
+
+    return {
+        "inputs": {k: v.tolist() for k, v in inp.items()},
+        "gamma": gamma,
+        "gae_lambda": gae_lambda,
+        "lmbda": lmbda,
+        "two_hot_support": support,
+        "two_hot_buckets": buckets,
+        "rmsprop": {"lr": lr, "alpha": alpha, "eps": eps, "momentum": momentum},
+        "expected": {
+            "returns": returns.tolist(),
+            "advantages": advantages.tolist(),
+            "lambda_values": lambda_values.tolist(),
+            "two_hot_encoded": encoded.tolist(),
+            "two_hot_decoded": decoded.tolist(),
+            "rmsprop_param_after_3_steps": p.detach().tolist(),
+        },
+    }
+
+
 def make_p2e_section() -> dict:
     """Plan2Explore intrinsic reward through the reference expression
     (reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:283 —
@@ -360,6 +451,7 @@ def main() -> None:
         "dreamer_v1": make_dv1_section(),
         "dreamer_v2": make_dv2_section(),
         "p2e": make_p2e_section(),
+        "math": make_math_section(),
         "meta": {
             "source": "sheeprl/algos/dreamer_v3/loss.py:9-88 (reference implementation)",
             "shapes": {"T": T, "B": B, "cnn": CNN_SHAPE, "mlp": MLP_DIM,

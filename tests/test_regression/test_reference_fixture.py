@@ -220,3 +220,50 @@ def test_p2e_intrinsic_reward_matches_reference(fixture):
     got = ensemble_disagreement(preds, sec["multiplier"])
     want = np.asarray(sec["expected"]["intrinsic_reward"], np.float32)
     np.testing.assert_allclose(np.asarray(got), want, rtol=RTOL, atol=ATOL)
+
+
+def test_math_utils_match_reference(fixture):
+    """GAE, TD(λ), the two-hot codec, and TF-style RMSprop against the
+    reference implementations on identical seeded inputs.  Note the API
+    difference under test: our two-hot codec applies symlog/symexp
+    INTERNALLY (the reference composes them at call sites), so the
+    comparison feeds symexp-ed inputs / wraps with symexp."""
+    import optax
+
+    from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values
+    from sheeprl_tpu.utils.optim import rmsprop_tf
+    from sheeprl_tpu.utils.utils import gae, symexp, two_hot_decoder, two_hot_encoder
+
+    sec = fixture["math"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+
+    returns, advantages = gae(
+        inp["rewards"], inp["values"], inp["dones"], inp["next_value"][0],
+        sec["gamma"], sec["gae_lambda"],
+    )
+    np.testing.assert_allclose(np.asarray(returns), sec["expected"]["returns"], rtol=RTOL, atol=ATOL)
+    np.testing.assert_allclose(np.asarray(advantages), sec["expected"]["advantages"], rtol=RTOL, atol=ATOL)
+
+    lam = compute_lambda_values(inp["lam_rewards"], inp["lam_values"], inp["lam_continues"], sec["lmbda"])
+    np.testing.assert_allclose(np.asarray(lam), sec["expected"]["lambda_values"], rtol=RTOL, atol=ATOL)
+
+    support, buckets = sec["two_hot_support"], sec["two_hot_buckets"]
+    encoded = two_hot_encoder(symexp(inp["two_hot_x"]), support, buckets)
+    np.testing.assert_allclose(
+        np.asarray(encoded), sec["expected"]["two_hot_encoded"], rtol=1e-4, atol=1e-4
+    )
+    decoded = two_hot_decoder(inp["two_hot_probs"], support)
+    np.testing.assert_allclose(
+        np.asarray(decoded), symexp(jnp.asarray(sec["expected"]["two_hot_decoded"])), rtol=RTOL, atol=ATOL
+    )
+
+    r = sec["rmsprop"]
+    opt = rmsprop_tf(r["lr"], decay=r["alpha"], eps=r["eps"], momentum=r["momentum"])
+    p = inp["opt_param"]
+    state = opt.init(p)
+    for i in range(3):
+        updates, state = opt.update(inp["opt_grads"][i], state, p)
+        p = optax.apply_updates(p, updates)
+    np.testing.assert_allclose(
+        np.asarray(p), sec["expected"]["rmsprop_param_after_3_steps"], rtol=1e-4, atol=1e-5
+    )
