@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import copy
 import os
+import warnings
 import tempfile
 import time
 from typing import Any, Dict, Optional, Tuple
@@ -218,34 +219,52 @@ class Ratio:
             raise ValueError(f"ratio must be non-negative, got {ratio}")
         self._ratio = float(ratio)
         self._pretrain_steps = int(pretrain_steps)
-        self._prev_in_steps = 0
-        self._accum = 0.0
+        self._prev: Optional[float] = None
 
     def __call__(self, in_steps: int) -> int:
-        out = 0
-        if self._prev_in_steps == 0 and self._pretrain_steps > 0:
-            out = self._pretrain_steps
-        delta = in_steps - self._prev_in_steps
-        self._accum += delta * self._ratio
-        whole = int(self._accum)
-        out += whole
-        self._accum -= whole
-        self._prev_in_steps = in_steps
-        return out
+        # Hafner's law, matching the reference exactly
+        # (reference: sheeprl/utils/utils.py:273-291): the FIRST call converts
+        # pretrain_steps (clamped to the current step count, in STEP units)
+        # when set, else the current steps; later calls convert the delta and
+        # carry the fractional remainder in step units via ``_prev``.
+        if self._ratio == 0:
+            return 0
+        if self._prev is None:
+            self._prev = in_steps
+            if self._pretrain_steps > 0:
+                if in_steps < self._pretrain_steps:
+                    warnings.warn(
+                        "pretrain_steps exceeds the current step count; clamping "
+                        "to the current steps (reference behavior)", UserWarning
+                    )
+                    self._pretrain_steps = in_steps
+                return int(self._pretrain_steps * self._ratio)
+            return int(in_steps * self._ratio)
+        repeats = int((in_steps - self._prev) * self._ratio)
+        self._prev += repeats / self._ratio
+        return repeats
 
     def state_dict(self) -> Dict[str, Any]:
         return {
             "ratio": self._ratio,
             "pretrain_steps": self._pretrain_steps,
-            "prev_in_steps": self._prev_in_steps,
-            "accum": self._accum,
+            "prev": self._prev,
         }
 
     def load_state_dict(self, state: Dict[str, Any]) -> "Ratio":
         self._ratio = float(state["ratio"])
         self._pretrain_steps = int(state["pretrain_steps"])
-        self._prev_in_steps = int(state["prev_in_steps"])
-        self._accum = float(state["accum"])
+        if "prev" in state:
+            self._prev = None if state["prev"] is None else float(state["prev"])
+        else:
+            # legacy layout (accumulator-based): translate so a resumed run
+            # keeps the same future output stream
+            prev_in = int(state["prev_in_steps"])
+            accum = float(state["accum"])
+            if prev_in == 0 and accum == 0.0:
+                self._prev = None
+            else:
+                self._prev = prev_in - (accum / self._ratio if self._ratio else 0.0)
         return self
 
 

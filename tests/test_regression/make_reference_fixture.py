@@ -303,15 +303,18 @@ def make_dv2_section() -> dict:
 
 
 def load_ref_functions(rel: str, names: tuple, extra_ns: dict) -> dict:
-    """Compile ONLY the named top-level functions out of a reference file —
-    sidesteps module-level imports (lightning, omegaconf, rich) this image
-    lacks.  The functions' own bodies use only what ``extra_ns`` provides."""
+    """Compile ONLY the named top-level functions/classes out of a reference
+    file — sidesteps module-level imports (lightning, omegaconf, rich) this
+    image lacks.  The bodies use only what ``extra_ns`` provides."""
     import ast
 
     src = (REFERENCE / rel).read_text()
     tree = ast.parse(src)
-    wanted = [n for n in tree.body if isinstance(n, ast.FunctionDef) and n.name in names]
-    assert len(wanted) == len(names), f"missing functions in {rel}"
+    wanted = [
+        n for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.ClassDef)) and n.name in names
+    ]
+    assert len(wanted) == len(names), f"missing definitions in {rel}"
     ns = dict(extra_ns)
     for node in wanted:
         node.decorator_list = []  # e.g. @torch.no_grad()
@@ -365,6 +368,29 @@ def make_math_section() -> dict:
 
     # 3 RMSpropTF steps on a seeded param with momentum (constant lr; the
     # reference's lr_in_momentum only differs under a mid-run lr change)
+    # Ratio governor: reference law over a mixed call sequence, including
+    # the pretrain clamp and fractional-carry behavior
+    import warnings as _w
+
+    RefRatio = load_ref_functions(
+        "sheeprl/utils/utils.py", ("Ratio",),
+        {"warnings": _w, "Dict": dict, "Any": object, "Mapping": dict},
+    )["Ratio"]
+    ratio_cases = []
+    for ratio, pretrain, calls in [
+        (0.5, 0, [1, 2, 3, 10, 100, 101]),
+        (1.0, 7, [4, 10, 20]),
+        (0.0625, 1024, [2048, 2052, 2112, 4096]),
+        (2.0, 0, [3, 4, 10]),
+    ]:
+        with _w.catch_warnings():
+            _w.simplefilter("ignore")
+            r = RefRatio(ratio, pretrain_steps=pretrain)
+            ratio_cases.append({
+                "ratio": ratio, "pretrain_steps": pretrain, "calls": calls,
+                "expected": [int(r(c)) for c in calls],
+            })
+
     rmsprop_mod = load_ref_module("ref_rmsprop_tf", "sheeprl/optim/rmsprop_tf.py")
     lr, alpha, eps, momentum = 0.05, 0.9, 1e-10, 0.9
     p = torch.nn.Parameter(t["opt_param"].clone())
@@ -382,6 +408,7 @@ def make_math_section() -> dict:
         "two_hot_support": support,
         "two_hot_buckets": buckets,
         "rmsprop": {"lr": lr, "alpha": alpha, "eps": eps, "momentum": momentum},
+        "ratio_cases": ratio_cases,
         "expected": {
             "returns": returns.tolist(),
             "advantages": advantages.tolist(),

@@ -267,3 +267,31 @@ def test_math_utils_match_reference(fixture):
     np.testing.assert_allclose(
         np.asarray(p), sec["expected"]["rmsprop_param_after_3_steps"], rtol=1e-4, atol=1e-5
     )
+
+
+def test_ratio_matches_reference(fixture):
+    """The Ratio replay governor follows the reference's (Hafner's) law:
+    the first call converts pretrain_steps (clamped to the current steps)
+    when set, else the current steps; later calls convert the step delta
+    with the fractional remainder carried in step units."""
+    import warnings
+
+    from sheeprl_tpu.utils.utils import Ratio
+
+    for case in fixture["math"]["ratio_cases"]:
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            r = Ratio(case["ratio"], pretrain_steps=case["pretrain_steps"])
+            got = [r(c) for c in case["calls"]]
+        assert got == case["expected"], (
+            f"ratio={case['ratio']} pretrain={case['pretrain_steps']} "
+            f"calls={case['calls']}: repo={got} reference={case['expected']}"
+        )
+        # state roundtrip mid-stream preserves the future output stream
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            r1 = Ratio(case["ratio"], pretrain_steps=case["pretrain_steps"])
+            r1(case["calls"][0])
+            r2 = Ratio(case["ratio"]).load_state_dict(r1.state_dict())
+            for c in case["calls"][1:]:
+                assert r1(c) == r2(c)
