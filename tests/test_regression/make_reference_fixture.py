@@ -420,6 +420,32 @@ def make_math_section() -> dict:
     }
 
 
+def make_truncnorm_section() -> dict:
+    """TruncatedNormal([-1,1]) — the DV1/DV2 continuous-action policy
+    distribution — log_prob / mean / entropy through the reference
+    (reference: sheeprl/utils/distribution.py:25-150)."""
+    import torch
+
+    dist, _ = load_reference_oracle()
+    rng = np.random.default_rng(31)
+    n = 16
+    inp = {
+        "loc": rng.uniform(-1.5, 1.5, n).astype(np.float32),
+        "scale": rng.uniform(0.1, 1.2, n).astype(np.float32),
+        "value": rng.uniform(-0.99, 0.99, n).astype(np.float32),
+    }
+    t = {k: torch.from_numpy(v) for k, v in inp.items()}
+    d = dist.TruncatedNormal(t["loc"], t["scale"], -1.0, 1.0)
+    return {
+        "inputs": {k: v.tolist() for k, v in inp.items()},
+        "expected": {
+            "log_prob": d.log_prob(t["value"]).tolist(),
+            "mean": d.mean.tolist(),
+            "entropy": d.entropy().tolist(),
+        },
+    }
+
+
 def make_p2e_section() -> dict:
     """Plan2Explore intrinsic reward through the reference expression
     (reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:283 —
@@ -479,6 +505,7 @@ def main() -> None:
         "dreamer_v2": make_dv2_section(),
         "p2e": make_p2e_section(),
         "math": make_math_section(),
+        "truncated_normal": make_truncnorm_section(),
         "meta": {
             "source": "sheeprl/algos/dreamer_v3/loss.py:9-88 (reference implementation)",
             "shapes": {"T": T, "B": B, "cnn": CNN_SHAPE, "mlp": MLP_DIM,

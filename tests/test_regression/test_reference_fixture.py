@@ -295,3 +295,18 @@ def test_ratio_matches_reference(fixture):
             r2 = Ratio(case["ratio"]).load_state_dict(r1.state_dict())
             for c in case["calls"][1:]:
                 assert r1(c) == r2(c)
+
+
+def test_truncated_normal_matches_reference(fixture):
+    from sheeprl_tpu.utils.distribution import TruncatedNormal
+
+    sec = fixture["truncated_normal"]
+    inp = {k: jnp.asarray(np.asarray(v, np.float32)) for k, v in sec["inputs"].items()}
+    d = TruncatedNormal(inp["loc"], inp["scale"], -1.0, 1.0)
+    np.testing.assert_allclose(
+        np.asarray(d.log_prob(inp["value"])), sec["expected"]["log_prob"], rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(np.asarray(d.mean), sec["expected"]["mean"], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(d.entropy()), sec["expected"]["entropy"], rtol=1e-4, atol=1e-5
+    )
