@@ -7,11 +7,25 @@ pixels under ``rgb``.  Gated on ``dm_control`` availability.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 import gymnasium as gym
 import numpy as np
 from gymnasium import spaces
+
+# Headless default: TPU VMs have no X display; MuJoCo's default glfw backend
+# needs one.  EGL renders headless on CPU/GPU alike — pick it before the
+# first dm_control import unless the user chose a backend themselves.
+# Linux-only: macOS has no EGL (MuJoCo uses cgl there without DISPLAY).
+import sys as _sys
+
+if (
+    _sys.platform.startswith("linux")
+    and "MUJOCO_GL" not in os.environ
+    and not os.environ.get("DISPLAY")
+):
+    os.environ["MUJOCO_GL"] = "egl"
 
 try:
     from dm_control import suite  # type: ignore

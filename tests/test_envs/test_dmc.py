@@ -1,0 +1,115 @@
+"""DMC wrapper tests against the REAL dm_control backend (present in this
+image; EGL renders headless).  These are the only suite tests that exercise
+a real physics engine rather than a mock — the observation contract, the
+terminated/truncated mapping, and the full make_env pipeline over real
+MuJoCo renders (reference surface: sheeprl/envs/dmc.py:49+)."""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.envs.dmc import _DMC_AVAILABLE
+
+pytestmark = pytest.mark.skipif(not _DMC_AVAILABLE, reason="dm_control not installed")
+
+
+def _cfg(extra=()):
+    from sheeprl_tpu.config.compose import compose
+
+    return compose(
+        [
+            "exp=sac",
+            "env=dmc",
+            "env.id=cartpole_balance",
+            "algo.mlp_keys.encoder=[state]",
+            "env.capture_video=False",
+            *extra,
+        ]
+    )
+
+
+def test_vectors_only_contract():
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = _cfg(["env.wrapper.from_pixels=False", "env.wrapper.from_vectors=True"])
+    env = make_env(cfg, seed=3, rank=0)()
+    assert set(env.observation_space.spaces) == {"state"}
+    obs, _ = env.reset()
+    assert obs["state"].dtype == np.float32 and obs["state"].ndim == 1
+    total = 0.0
+    for _ in range(5):
+        obs, r, term, trunc, _ = env.step(env.action_space.sample())
+        total += r
+        assert not term  # cartpole_balance has no early termination
+    env.close()
+
+
+def test_pixels_through_full_pipeline():
+    """Real MuJoCo EGL render → resize/grayscale pipeline → frame stack."""
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = _cfg(
+        [
+            "env.wrapper.from_pixels=True",
+            "env.wrapper.from_vectors=True",
+            "env.screen_size=64",
+            "env.frame_stack=3",
+            "algo.cnn_keys.encoder=[rgb]",
+        ]
+    )
+    env = make_env(cfg, seed=3, rank=0)()
+    obs, _ = env.reset()
+    # framework frame-stack contract: (stack, H, W, C) channel-last uint8,
+    # merged into channels at encoder input (see dv3 build_agent)
+    assert obs["rgb"].shape == (3, 64, 64, 3)
+    assert obs["rgb"].dtype == np.uint8
+    assert obs["rgb"].max() > 0  # a real render, not a black frame
+    assert obs["state"].dtype == np.float32
+    obs2, r, term, trunc, _ = env.step(env.action_space.sample())
+    assert obs2["rgb"].shape == (3, 64, 64, 3)
+    env.close()
+
+
+def test_action_repeat_and_seeding_determinism():
+    from sheeprl_tpu.utils.env import make_env
+
+    cfg = _cfg(["env.wrapper.from_pixels=False", "env.wrapper.from_vectors=True", "env.action_repeat=2"])
+    rollouts = []
+    for _ in range(2):
+        env = make_env(cfg, seed=11, rank=0)()
+        obs, _ = env.reset(seed=11)
+        acts = np.linspace(-1, 1, 4, dtype=np.float32)
+        traj = []
+        for a in acts:
+            o, r, *_ = env.step(np.full(env.action_space.shape, a, np.float32))
+            traj.append((o["state"].copy(), r))
+        env.close()
+        rollouts.append(traj)
+    for (o1, r1), (o2, r2) in zip(*rollouts):
+        np.testing.assert_allclose(o1, o2)
+        assert r1 == r2
+
+
+def test_dreamer_v3_e2e_on_real_dmc_pixels(tmp_path):
+    """Full DreamerV3 training iteration over REAL MuJoCo physics + EGL
+    renders through the actual CLI — the only E2E that crosses a real
+    simulator (everything else uses the deterministic dummy envs)."""
+    from sheeprl_tpu.cli import run
+
+    run(
+        [
+            "exp=dreamer_v3", "env=dmc", "env.id=cartpole_balance",
+            "algo=dreamer_v3_XS", "dry_run=True",
+            "env.num_envs=1", "env.sync_env=True", "env.capture_video=False",
+            "env.action_repeat=2",
+            "fabric.devices=1", "fabric.accelerator=cpu",
+            "algo.learning_starts=32", "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=8",
+            "algo.world_model.recurrent_model.recurrent_state_size=32",
+            "algo.world_model.stochastic_size=4", "algo.world_model.discrete_size=4",
+            "algo.dense_units=16", "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=2",
+            "metric.log_level=0", "checkpoint.every=0", "checkpoint.save_last=False",
+            "buffer.memmap=False", "algo.run_test=False", "print_config=False",
+            f"log_dir={tmp_path}",
+        ]
+    )
