@@ -641,3 +641,38 @@ def test_dreamer_v3_accelerator_player(tmp_path):
         ],
     )
     run(args)
+
+
+@pytest.mark.parametrize(
+    "exp,extra",
+    [
+        ("ppo_decoupled", ["algo.rollout_steps=8", "algo.per_rank_batch_size=8", "algo.update_epochs=1"]),
+        ("sac_decoupled", ["algo.per_rank_batch_size=8", "algo.learning_starts=8", "buffer.size=256"]),
+    ],
+)
+def test_evaluation_cli_after_decoupled(tmp_logdir, exp, extra):
+    """Decoupled-run checkpoints must be evaluable: the saved config carries
+    algo.name=<algo>_decoupled, which needs its own evaluation registration
+    (reference: sheeprl/algos/ppo/evaluate.py:58, sac/evaluate.py:15)."""
+    env_id = "discrete_dummy" if exp == "ppo_decoupled" else "continuous_dummy"
+    args = standard_args(
+        tmp_logdir,
+        extra=[
+            f"exp={exp}",
+            "env=dummy",
+            f"env.id={env_id}",
+            "algo.mlp_keys.encoder=[state]",
+            "env.max_episode_steps=16",
+            "algo.run_test=False",
+            *extra,
+        ],
+        devices=2,
+    )
+    run(args)
+    import glob
+
+    from sheeprl_tpu.cli import evaluation
+
+    ckpts = glob.glob(f"{tmp_logdir}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert ckpts
+    evaluation([f"checkpoint_path={ckpts[0]}", "env.capture_video=False"])
