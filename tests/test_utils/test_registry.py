@@ -35,3 +35,16 @@ def test_decoupled_variant_selection():
 def test_unknown_algorithm_raises():
     with pytest.raises(ValueError):
         resolve_algorithm("definitely_not_registered")
+
+
+def test_every_algorithm_has_an_evaluation():
+    """The reference validates the evaluation registry against the algorithm
+    registry (reference: sheeprl/utils/registry.py:38-94); without an entry,
+    'sheeprl-tpu eval' refuses that algorithm's checkpoints outright (the
+    decoupled variants regressed exactly this way once)."""
+    import sheeprl_tpu
+    from sheeprl_tpu.utils.registry import algorithm_registry, evaluation_registry
+
+    sheeprl_tpu.register_all_algorithms()
+    missing = [n for n in algorithm_registry if n not in evaluation_registry]
+    assert not missing, f"algorithms without a registered evaluation: {missing}"
