@@ -1,0 +1,120 @@
+"""Template for a decoupled player/trainer topology — the TPU-native
+equivalent of the reference's multi-process collectives demo
+(reference parity: examples/architecture_template.py, which spawns
+buffer/player/trainer processes over TorchCollective).
+
+The reference needs three process groups and explicit object collectives.
+The JAX runtime needs less machinery: each PROCESS owns its devices, the
+trainer group is a sub-mesh, and host-object collectives (pickled pytrees
+over the jax.distributed KV store) carry rollouts one way and weights the
+other — see the production implementation in
+sheeprl_tpu/algos/ppo/ppo_decoupled.py (dedicated topology) and
+sheeprl_tpu/parallel/fabric.py (host collectives).
+
+This template runs N processes on localhost CPU to show the skeleton:
+
+    python examples/architecture_template.py --processes 3
+
+process 0 = player (steps a fake env, ships rollouts), processes 1..N-1 =
+trainers (consume rollouts, ship updated params back).  The lockstep
+protocol (sync A: rollout -> trainers, sync B: weights -> player) is the
+same one the real decoupled algorithms use.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+
+def player(fabric, steps: int) -> None:
+    import numpy as np
+
+    params = fabric.broadcast_object(None, src=1)  # initial weights from trainer 1
+    for step in range(steps):
+        rollout = {"obs": np.random.default_rng(step).normal(size=(8, 4)).astype(np.float32)}
+        # sync A: rollout -> every trainer
+        fabric.broadcast_object(rollout, src=0)
+        # sync B: refreshed weights <- trainer 1
+        params = fabric.broadcast_object(None, src=1)
+        print(f"[player] step {step}: got params v{params['version']}", flush=True)
+
+
+def trainer(fabric, steps: int) -> None:
+    import numpy as np
+
+    params = {"w": np.zeros(4, np.float32), "version": 0}
+    if fabric.global_rank == 1:
+        fabric.broadcast_object(params, src=1)
+    else:
+        fabric.broadcast_object(None, src=1)
+    for step in range(steps):
+        rollout = fabric.broadcast_object(None, src=0)  # sync A
+        params = {"w": params["w"] + rollout["obs"].mean(0), "version": params["version"] + 1}
+        # (real trainers run the jitted update over the trainer sub-mesh here)
+        fabric.broadcast_object(params if fabric.global_rank == 1 else None, src=1)  # sync B
+        print(f"[trainer {fabric.global_rank}] step {step}: trained v{params['version']}", flush=True)
+
+
+def worker(steps: int) -> None:
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    cfg = compose(
+        [
+            "env=dummy", "env.id=discrete_dummy", "algo=ppo",
+            "algo.total_steps=1", "algo.per_rank_batch_size=1",
+            "fabric.accelerator=cpu",
+        ]
+    )
+    fabric = build_fabric(cfg)
+    if fabric.global_rank == 0:
+        player(fabric, steps)
+    else:
+        trainer(fabric, steps)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--processes", type=int, default=3)
+    p.add_argument("--steps", type=int, default=3)
+    p.add_argument("--port", type=int, default=12939)
+    args = p.parse_args()
+
+    if os.environ.get("_ARCH_TEMPLATE_WORKER"):
+        import jax
+
+        jax.distributed.initialize(
+            f"127.0.0.1:{args.port}",
+            num_processes=args.processes,
+            process_id=int(os.environ["_ARCH_TEMPLATE_WORKER"]) - 1,
+        )
+        worker(args.steps)
+        return
+
+    if args.processes < 2:
+        p.error("--processes must be >= 2 (one player + at least one trainer)")
+    procs = []
+    for rank in range(args.processes):
+        env = {
+            **os.environ,
+            "_ARCH_TEMPLATE_WORKER": str(rank + 1),
+            "JAX_PLATFORMS": "cpu",
+        }
+        procs.append(subprocess.Popen([sys.executable, __file__] + sys.argv[1:], env=env))
+    try:
+        rcs = [pr.wait(timeout=300) for pr in procs]
+    finally:
+        for pr in procs:
+            if pr.poll() is None:
+                pr.kill()
+    sys.exit(1 if any(rc != 0 for rc in rcs) else 0)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,34 @@
+"""Print the WRAPPED observation space an agent will actually see for any
+env/algo config — the full make_env pipeline (Dict normalization, resize,
+frame stack, reward/actions-as-obs) applied (reference parity:
+examples/observation_space.py).
+
+Usage:
+    python examples/observation_space.py exp=dreamer_v3 env=dmc env.id=walker_walk
+    python examples/observation_space.py exp=ppo env.id=CartPole-v1 env.frame_stack=4
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.utils.env import make_env
+
+
+def main(argv) -> None:
+    cfg = compose(list(argv) + ["env.capture_video=False"])
+    env = make_env(cfg, cfg.seed, rank=0)()
+    print(f"\nObservation space of `{cfg.env.id}` for `{cfg.algo.name}`:")
+    print(env.observation_space)
+    print(f"\nAction space: {env.action_space}")
+    print(
+        "\nKeys the agent encodes (algo.cnn_keys/mlp_keys): "
+        f"cnn={list(cfg.algo.cnn_keys.encoder)} mlp={list(cfg.algo.mlp_keys.encoder)}"
+    )
+    env.close()
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

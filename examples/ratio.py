@@ -1,0 +1,41 @@
+"""Demonstrate the Ratio replay governor — how `algo.replay_ratio` converts
+environment steps into gradient steps over time (reference parity:
+examples/ratio.py; the law is Hafner's, pinned to the reference in
+tests/test_regression/test_reference_fixture.py::test_ratio_matches_reference).
+
+Usage:
+    python examples/ratio.py [replay_ratio] [num_envs] [rollout_len]
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))  # repo root
+
+from sheeprl_tpu.utils.utils import Ratio
+
+
+def main(argv) -> None:
+    replay_ratio = float(argv[0]) if argv else 0.5
+    num_envs = int(argv[1]) if len(argv) > 1 else 4
+    rollout = int(argv[2]) if len(argv) > 2 else 16
+
+    r = Ratio(replay_ratio)
+    policy_steps = 0
+    total_updates = 0
+    print(f"replay_ratio={replay_ratio}  num_envs={num_envs}  rollout={rollout}\n")
+    print(f"{'iteration':>9} {'policy_steps':>12} {'updates_now':>11} {'total_updates':>13} {'real_ratio':>10}")
+    for it in range(1, 11):
+        policy_steps += num_envs * rollout
+        updates = r(policy_steps)
+        total_updates += updates
+        print(
+            f"{it:>9} {policy_steps:>12} {updates:>11} {total_updates:>13} "
+            f"{total_updates / policy_steps:>10.4f}"
+        )
+    print("\nThe realized ratio converges to replay_ratio; fractional remainders")
+    print("carry between iterations instead of being dropped.")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
