@@ -139,7 +139,7 @@ def main(fabric: Any, cfg: Any) -> None:
     def one_update(carry, batch_and_key):
         p, o_state, step_idx = carry
         batch, k = batch_and_key
-        k_next, k_pi = jax.random.split(k)
+        k_next, k_pi, k_dec = jax.random.split(k, 3)
         alpha = jnp.exp(p["log_alpha"])
 
         obs = normalize_obs_block(batch, cnn_keys, obs_keys, offset=0.0)
@@ -208,11 +208,22 @@ def main(fabric: Any, cfg: Any) -> None:
             def d_loss(ep, dp):
                 feats = encoder.apply(ep, obs)
                 recon = decoder.apply(dp, feats)
+                # reference decoder objective (sheeprl/algos/sac_ae/sac_ae.py:100-109):
+                # per decoder key, mse against the 5-bit-quantized + dithered
+                # target (cnn; utils.py:68-76) PLUS 0.5*lambda*||h||^2 — the L2
+                # penalty is counted once per key, matching the reference loop
+                l2 = 0.5 * l2_lambda * jnp.mean(jnp.sum(feats**2, axis=-1))
                 loss = 0.0
-                for kk in obs_keys:
-                    target = obs[kk] - 0.5 if kk in cnn_keys else obs[kk]
-                    loss = loss + jnp.mean((recon[kk] - target) ** 2)
-                return loss + l2_lambda * jnp.mean(jnp.sum(feats**2, axis=-1))
+                for i, kk in enumerate(obs_keys):
+                    if kk in cnn_keys:
+                        raw = obs[kk] * 255.0  # obs normalized to [0,1] upstream
+                        quant = jnp.floor(raw / 8.0) / 32.0
+                        dither = jax.random.uniform(jax.random.fold_in(k_dec, i), obs[kk].shape) / 32.0
+                        target = quant + dither - 0.5
+                    else:
+                        target = obs[kk]
+                    loss = loss + jnp.mean((recon[kk] - target) ** 2) + l2
+                return loss
 
             dl, (e_grads, d_grads) = jax.value_and_grad(d_loss, argnums=(0, 1))(
                 p["encoder"], p["decoder"]

@@ -446,6 +446,33 @@ def make_truncnorm_section() -> dict:
     }
 
 
+def make_sac_ae_section() -> dict:
+    """SAC-AE decoder target preprocessing through the reference
+    (reference: sheeprl/algos/sac_ae/utils.py:68-76 — 5-bit quantization +
+    uniform dither).  The dither is stochastic, so it is zeroed on both
+    sides; the deterministic quantization grid is what a transcription
+    error would break."""
+    import torch
+
+    fns = load_ref_functions(
+        "sheeprl/algos/sac_ae/utils.py", ("preprocess_obs",),
+        {"torch": torch, "Tensor": torch.Tensor},
+    )
+    rng = np.random.default_rng(37)
+    raw = rng.integers(0, 256, (2, 8, 8, 3)).astype(np.float32)
+    orig_rand = torch.rand_like
+    torch.rand_like = lambda t: torch.zeros_like(t)
+    try:
+        expected = fns["preprocess_obs"](torch.from_numpy(raw), bits=5)
+    finally:
+        torch.rand_like = orig_rand
+    return {
+        "inputs": {"raw": raw.tolist()},
+        "bits": 5,
+        "expected": {"target": expected.tolist()},
+    }
+
+
 def make_p2e_section() -> dict:
     """Plan2Explore intrinsic reward through the reference expression
     (reference: sheeprl/algos/p2e_dv3/p2e_dv3_exploration.py:283 —
@@ -506,6 +533,7 @@ def main() -> None:
         "p2e": make_p2e_section(),
         "math": make_math_section(),
         "truncated_normal": make_truncnorm_section(),
+        "sac_ae": make_sac_ae_section(),
         "meta": {
             "source": "sheeprl/algos/dreamer_v3/loss.py:9-88 (reference implementation)",
             "shapes": {"T": T, "B": B, "cnn": CNN_SHAPE, "mlp": MLP_DIM,

@@ -310,3 +310,15 @@ def test_truncated_normal_matches_reference(fixture):
     np.testing.assert_allclose(
         np.asarray(d.entropy()), sec["expected"]["entropy"], rtol=1e-4, atol=1e-5
     )
+
+
+def test_sac_ae_decoder_target_matches_reference(fixture):
+    """The 5-bit quantized decoder target (dither zeroed on both sides)
+    against the reference preprocess_obs; the train step adds the dither
+    from its own PRNG stream (sac_ae.py one_update)."""
+    sec = fixture["sac_ae"]
+    raw = jnp.asarray(np.asarray(sec["inputs"]["raw"], np.float32))
+    got = jnp.floor(raw / 8.0) / 32.0 - 0.5  # the deterministic part of the target
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(sec["expected"]["target"], np.float32), rtol=RTOL, atol=ATOL
+    )
