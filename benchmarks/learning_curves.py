@@ -62,15 +62,28 @@ def _write_index(results: dict) -> None:
         "opt-in slow tests in `tests/test_learning/`).  `final` is the mean of",
         "the last quarter of logged `Rewards/rew_avg` points.",
         "",
-        "| workload | final reward | threshold | wall-clock | status |",
-        "|---|---|---|---|---|",
+        "| workload | final reward | threshold | random baseline | wall-clock | status |",
+        "|---|---|---|---|---|---|",
     ]
     for name, r in sorted(results.items()):
         status = "PASS" if r["final_reward"] >= r["threshold"] else "FAIL"
+        base = WORKLOADS.get(name, {}).get("random_baseline")
+        base_s = f"{base[0]:.1f} ± {base[1]:.1f}" if base else "—"
         lines.append(
-            f"| {name} | {r['final_reward']:.1f} | {r['threshold']} | {r['wall_clock_s']:.0f}s | {status} |"
+            f"| {name} | {r['final_reward']:.1f} | {r['threshold']} | {base_s} "
+            f"| {r['wall_clock_s']:.0f}s | {status} |"
         )
-    lines.append("")
+    lines.extend(
+        [
+            "",
+            "Random baselines are the mean ± std episode return of a",
+            "uniform-random policy over 10 episodes on the same wrapper stack",
+            "(measured once, recorded in `learning_runs.py`); thresholds are",
+            "chosen many standard deviations above them so a half-broken agent",
+            "cannot pass.",
+            "",
+        ]
+    )
     (CURVES_DIR / "LEARNING.md").write_text("\n".join(lines))
 
 

@@ -269,12 +269,17 @@ def main(fabric: Any, cfg: Any) -> None:
     n_shards = fabric.num_processes if sharded_envs else 1
     if n_shards > 1 and (global_bs % n_shards or B % n_shards):
         if not share_data:
+            # share_data=False is the SHIPPED default (configs/exp/ppo.yaml),
+            # so a hard error here would abort previously-working runs; the
+            # fallback is instead documented in howto/configs.md (ADVICE r4)
             import warnings
 
             warnings.warn(
                 f"buffer.share_data=False needs equal per-process batch slices "
                 f"(batch {global_bs}, envs {B}, processes {n_shards}): falling "
-                "back to the global-pool (share_data=True) sampler"
+                "back to the global-pool (share_data=True) sampler — pick a "
+                "divisible algo.per_rank_batch_size/env.num_envs to keep "
+                "shard-local sampling (see howto/configs.md)"
             )
         n_shards = 1  # uneven split: fall back to the global-pool sampler
     # GLOBAL env-step accounting: every process steps its own envs

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from sheeprl_tpu.algos.sac.agent import SACActor, SACCriticEnsemble
-from sheeprl_tpu.models.models import CNN, DeCNN, MLP
+from sheeprl_tpu.models.models import CNN, MLP, MultiDecoder
 
 
 class AEEncoder(nn.Module):
@@ -63,52 +63,6 @@ class AEEncoder(nn.Module):
         return jnp.tanh(x)
 
 
-class AEDecoder(nn.Module):
-    """Feature vector → per-key reconstructions."""
-
-    cnn_keys: Tuple[str, ...]
-    mlp_keys: Tuple[str, ...]
-    cnn_shapes: Dict[str, Tuple[int, int, int]]
-    mlp_shapes: Dict[str, int]
-    cnn_mult: int = 16
-    dense_units: int = 64
-    mlp_layers: int = 2
-    dtype: Any = jnp.float32
-
-    @nn.compact
-    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
-        out: Dict[str, jax.Array] = {}
-        if self.cnn_keys:
-            h0 = next(iter(self.cnn_shapes.values()))[0] // 8
-            total_c = sum(self.cnn_shapes[k][-1] for k in self.cnn_keys)
-            x = nn.Dense(h0 * h0 * self.cnn_mult * 4, dtype=self.dtype, name="cnn_in")(features)
-            x = nn.relu(x)
-            x = x.reshape(*x.shape[:-1], h0, h0, self.cnn_mult * 4)
-            x = DeCNN(
-                channels=(self.cnn_mult * 2, self.cnn_mult, total_c),
-                kernel_sizes=4,
-                strides=2,
-                activation="relu",
-                dtype=self.dtype,
-                name="decnn",
-            )(x)
-            start = 0
-            for k in self.cnn_keys:
-                c = self.cnn_shapes[k][-1]
-                out[k] = x[..., start:start + c]
-                start += c
-        if self.mlp_keys:
-            trunk = MLP(
-                hidden_sizes=(self.dense_units,) * self.mlp_layers,
-                activation="relu",
-                dtype=self.dtype,
-                name="mlp",
-            )(features)
-            for k in self.mlp_keys:
-                out[k] = nn.Dense(self.mlp_shapes[k], dtype=jnp.float32, name=f"head_{k}")(trunk)
-        return out
-
-
 def build_agent(
     fabric: Any,
     act_dim: int,
@@ -136,14 +90,16 @@ def build_agent(
         mlp_layers=cfg.algo.encoder.mlp_layers,
         dtype=dtype,
     )
-    decoder = AEDecoder(
+    dec_mult = cfg.algo.decoder.cnn_channels_multiplier
+    decoder = MultiDecoder(
         cnn_keys=cnn_keys,
         mlp_keys=mlp_keys,
         cnn_shapes=cnn_shapes,
         mlp_shapes=mlp_shapes,
-        cnn_mult=cfg.algo.decoder.cnn_channels_multiplier,
-        dense_units=cfg.algo.decoder.dense_units,
-        mlp_layers=cfg.algo.decoder.mlp_layers,
+        cnn_channels=(dec_mult * 2, dec_mult),
+        cnn_stem_channels=dec_mult * 4,
+        mlp_sizes=(cfg.algo.decoder.dense_units,) * cfg.algo.decoder.mlp_layers,
+        activation="relu",
         dtype=dtype,
     )
     actor = SACActor(act_dim=act_dim, hidden_size=cfg.algo.hidden_size, dtype=dtype)

@@ -216,7 +216,11 @@ def main(fabric: Any, cfg: Any) -> None:
                 loss = 0.0
                 for i, kk in enumerate(obs_keys):
                     if kk in cnn_keys:
-                        raw = obs[kk] * 255.0  # obs normalized to [0,1] upstream
+                        # obs normalized to [0,1] upstream; round back to the
+                        # exact uint8 grid before the 5-bit floor — the fp32
+                        # /255 round-trip can land one bucket low at exact
+                        # multiples of 8 (ADVICE r4)
+                        raw = jnp.round(obs[kk] * 255.0)
                         quant = jnp.floor(raw / 8.0) / 32.0
                         dither = jax.random.uniform(jax.random.fold_in(k_dec, i), obs[kk].shape) / 32.0
                         target = quant + dither - 0.5

@@ -322,6 +322,87 @@ class MultiEncoder(nn.Module):
         return jnp.concatenate(feats, axis=-1)
 
 
+class MultiDecoder(nn.Module):
+    """Latent features → per-key observation reconstructions
+    (reference: sheeprl/models/models.py:480-504).
+
+    The inverse of :class:`MultiEncoder`: one shared DeCNN branch emits all
+    ``cnn_keys`` concatenated on channels (then split per key), and one
+    shared MLP trunk feeds a per-key Dense head for each of ``mlp_keys``.
+    The CNN branch stems from a Dense projection to a
+    ``(h0, w0, cnn_stem_channels)`` seed where ``h0 = H / 2**n_deconvs`` —
+    so ``cnn_channels`` must agree with the target resolution
+    (``len(cnn_channels) + 1`` stride-2 deconvs).
+
+    MLP heads emit fp32 regardless of the compute dtype — reconstruction
+    targets feed losses, and keeping the head output fp32 is this repo's
+    LayerNorm-style numerics policy.
+    """
+
+    cnn_keys: Tuple[str, ...]
+    mlp_keys: Tuple[str, ...]
+    cnn_shapes: Dict[str, Tuple[int, int, int]] = None  # key -> (H, W, C)
+    mlp_shapes: Dict[str, int] = None  # key -> flat dim
+    cnn_channels: Sequence[int] = (64, 32)
+    cnn_stem_channels: int = 128
+    mlp_sizes: Sequence[int] = (256, 256)
+    kernel_size: int = 4
+    stride: int = 2
+    activation: Union[str, Activation] = "relu"
+    dtype: Any = jnp.float32
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, features: jax.Array) -> Dict[str, jax.Array]:
+        if not self.cnn_keys and not self.mlp_keys:
+            raise ValueError("MultiDecoder needs at least one cnn or mlp key")
+        act = get_activation(self.activation)
+        out: Dict[str, jax.Array] = {}
+        if self.cnn_keys:
+            n_deconvs = len(self.cnn_channels) + 1
+            h, w, _ = next(iter(self.cnn_shapes.values()))
+            h0, w0 = h // 2**n_deconvs, w // 2**n_deconvs
+            total_c = sum(self.cnn_shapes[k][-1] for k in self.cnn_keys)
+            x = nn.Dense(
+                h0 * w0 * self.cnn_stem_channels,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="cnn_in",
+            )(features)
+            x = act(x)
+            x = x.reshape(*x.shape[:-1], h0, w0, self.cnn_stem_channels)
+            x = DeCNN(
+                channels=tuple(self.cnn_channels) + (total_c,),
+                kernel_sizes=self.kernel_size,
+                strides=self.stride,
+                activation=self.activation,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="decnn",
+            )(x)
+            start = 0
+            for k in self.cnn_keys:
+                c = self.cnn_shapes[k][-1]
+                out[k] = x[..., start:start + c]
+                start += c
+        if self.mlp_keys:
+            trunk = MLP(
+                hidden_sizes=self.mlp_sizes,
+                activation=self.activation,
+                dtype=self.dtype,
+                param_dtype=self.param_dtype,
+                name="mlp",
+            )(features)
+            for k in self.mlp_keys:
+                out[k] = nn.Dense(
+                    self.mlp_shapes[k],
+                    dtype=jnp.float32,
+                    param_dtype=self.param_dtype,
+                    name=f"head_{k}",
+                )(trunk)
+        return out
+
+
 def cnn_forward(fn: Callable, x: jax.Array, image_ndim: int = 3) -> jax.Array:
     """Flatten leading ``(T, B)`` dims around an image op, restore after —
     the ``(T, B, *)`` convention adapter (reference: sheeprl/utils/model.py:165+)."""

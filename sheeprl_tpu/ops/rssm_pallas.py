@@ -325,6 +325,18 @@ def _tiled_vmem_bytes(bt: int, tj: int, ZA: int, D: int, H: int) -> int:
     )
 
 
+def _legal_col_tiles(total: int, target: int = 512) -> list:
+    """Legal column tiles for a ``total``-wide axis, descending: every
+    divisor of ``total`` that is a multiple of 128 and ≤ target, seeded with
+    the :func:`_col_tile` choice.  When no 128-multiple divides ``total``
+    (3H < 128 or an odd width) the only legal tile is ``total`` itself
+    (ADVICE r4: stepping down from tj in raw -128 increments could miss
+    every divisor and give up while a smaller legal tile existed)."""
+    tiles = {t for t in range(128, min(total, target) + 1, 128) if total % t == 0}
+    tiles.add(_col_tile(total, target))
+    return sorted(tiles, reverse=True)
+
+
 def _plan_tiled(B: int, ZA: int, D: int, H: int, block_b: int):
     """Pick (bt, tj) so the tiled kernel's working set fits the VMEM budget
     (ADVICE r3: the tiled path previously had no accounting at all and XL
@@ -332,16 +344,16 @@ def _plan_tiled(B: int, ZA: int, D: int, H: int, block_b: int):
     only adds grid steps), then the batch tile; raises when even the
     smallest legal tiling cannot fit."""
     bt = min(block_b, B)
+    col_tiles = _legal_col_tiles(3 * H)
     while True:
-        tj = _col_tile(3 * H)
-        while (
-            _tiled_vmem_bytes(bt, tj, ZA, D, H) > _VMEM_WEIGHT_BUDGET_BYTES and tj > 128
-        ):
-            # next smaller 128-multiple divisor of 3H
-            smaller = [t for t in range(tj - 128, 127, -128) if (3 * H) % t == 0]
-            if not smaller:
-                break
-            tj = smaller[0]
+        tj = next(
+            (
+                t
+                for t in col_tiles
+                if _tiled_vmem_bytes(bt, t, ZA, D, H) <= _VMEM_WEIGHT_BUDGET_BYTES
+            ),
+            col_tiles[-1],
+        )
         if _tiled_vmem_bytes(bt, tj, ZA, D, H) <= _VMEM_WEIGHT_BUDGET_BYTES:
             return bt, tj
         if bt > 8:

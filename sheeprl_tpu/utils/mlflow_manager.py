@@ -104,6 +104,11 @@ class MlflowModelManager(AbstractModelManager):
             tag = (getattr(mv, "tags", None) or {}).get("stage")
             if tag:
                 return tag
+            # the version EXISTS but has no stage anywhere (mlflow 3.x
+            # removed the stage API): return the stage-less sentinel, not
+            # None — None means version-not-found and would make the
+            # caller's guard silently skip the first-ever transition
+            return "None"
         return stage
 
     def _append_changelog(self, name: str, version: str, entry: str, version_entry: Optional[str] = None) -> None:

@@ -304,12 +304,19 @@ _ACCELERATOR_ALIVE: Optional[bool] = None
 
 # Cross-process probe cache: a wedged tunnel costs the 90 s subprocess probe
 # once per TTL window, not once per bench target / graft entry (VERDICT r3).
-_PROBE_CACHE_PATH = os.path.join(tempfile.gettempdir(), "sheeprl_tpu_probe_cache")
+# Per-UID path + ownership check: on a multi-user host another user must not
+# be able to pre-create the file and poison the alive/wedged verdict.
+_PROBE_CACHE_PATH = os.path.join(
+    os.environ.get("XDG_RUNTIME_DIR") or tempfile.gettempdir(),
+    f"sheeprl_tpu_probe_cache.{os.getuid() if hasattr(os, 'getuid') else 'u'}",
+)
 _PROBE_CACHE_TTL_S = 600.0
 
 
 def _read_probe_cache() -> Optional[bool]:
     try:
+        if hasattr(os, "getuid") and os.stat(_PROBE_CACHE_PATH).st_uid != os.getuid():
+            return None
         with open(_PROBE_CACHE_PATH) as f:
             stamp, verdict = f.read().split()
         if time.time() - float(stamp) <= _PROBE_CACHE_TTL_S:
@@ -321,7 +328,7 @@ def _read_probe_cache() -> Optional[bool]:
 
 def _write_probe_cache(alive: bool) -> None:
     try:
-        fd, tmp = tempfile.mkstemp(dir=tempfile.gettempdir())
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(_PROBE_CACHE_PATH))
         with os.fdopen(fd, "w") as f:
             f.write(f"{time.time()} {'alive' if alive else 'wedged'}")
         os.replace(tmp, _PROBE_CACHE_PATH)

@@ -9,6 +9,7 @@ from sheeprl_tpu.models.models import (
     LayerNorm,
     LayerNormGRUCell,
     MLP,
+    MultiDecoder,
     MultiEncoder,
     NatureCNN,
     cnn_forward,
@@ -92,6 +93,49 @@ def test_multi_encoder_requires_keys():
     enc = MultiEncoder(cnn_keys=(), mlp_keys=())
     with pytest.raises(ValueError):
         enc.init(KEY, {})
+
+
+def test_multi_decoder_reconstructs_per_key():
+    dec = MultiDecoder(
+        cnn_keys=("rgb", "depth"),
+        mlp_keys=("state",),
+        cnn_shapes={"rgb": (32, 32, 3), "depth": (32, 32, 1)},
+        mlp_shapes={"state": 7},
+        cnn_channels=(16, 8),
+        cnn_stem_channels=32,
+        mlp_sizes=(16,),
+    )
+    feats = jnp.ones((2, 64))
+    params = dec.init(KEY, feats)
+    out = dec.apply(params, feats)
+    assert set(out) == {"rgb", "depth", "state"}
+    assert out["rgb"].shape == (2, 32, 32, 3)
+    assert out["depth"].shape == (2, 32, 32, 1)
+    assert out["state"].shape == (2, 7)
+    # heads stay fp32 under bf16 compute (loss-side numerics policy)
+    dec16 = dec.copy(dtype=jnp.bfloat16)
+    out16 = dec16.apply(dec16.init(KEY, feats), feats)
+    assert out16["state"].dtype == jnp.float32
+
+
+def test_multi_decoder_leading_time_batch_dims():
+    dec = MultiDecoder(
+        cnn_keys=("rgb",),
+        mlp_keys=(),
+        cnn_shapes={"rgb": (16, 16, 3)},
+        cnn_channels=(8,),
+        cnn_stem_channels=16,
+    )
+    feats = jnp.ones((5, 2, 32))  # (T, B, F)
+    params = dec.init(KEY, feats)
+    out = dec.apply(params, feats)
+    assert out["rgb"].shape == (5, 2, 16, 16, 3)
+
+
+def test_multi_decoder_requires_keys():
+    dec = MultiDecoder(cnn_keys=(), mlp_keys=())
+    with pytest.raises(ValueError):
+        dec.init(KEY, jnp.ones((2, 8)))
 
 
 def test_cnn_forward_tb_adapter():
