@@ -77,7 +77,7 @@ def _write_index(results: dict) -> None:
         [
             "",
             "Random baselines are the mean ± std episode return of a",
-            "uniform-random policy over 10 episodes on the same wrapper stack",
+            "uniform-random policy (10-100 episodes) on the same wrapper stack",
             "(measured once, recorded in `learning_runs.py`); thresholds are",
             "chosen many standard deviations above them so a half-broken agent",
             "cannot pass.",

@@ -139,7 +139,7 @@ def dreamer_family_loop(
     )
 
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+    timer.configure(cfg.metric)
 
     psync = PlayerSync(
         fabric, cfg, extract=lambda p: {"world_model": p["world_model"], "actor": p["actor"]}

@@ -118,7 +118,7 @@ def main(fabric: Any, cfg: Any) -> None:
     opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
 
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+    timer.configure(cfg.metric)
 
     # on-policy loops honor algo.player.device (placement only; the sync
     # cadence options are meaningless on-policy: rollouts must use the

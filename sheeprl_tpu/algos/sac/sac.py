@@ -195,7 +195,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     aggregator = MetricAggregator(
         cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {}
     )
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+    timer.configure(cfg.metric)
 
     psync = PlayerSync(fabric, cfg, extract=lambda p: p["actor"])
     host = psync.device  # single resolution of algo.player.device

@@ -163,7 +163,7 @@ def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
     )
 
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+    timer.configure(cfg.metric)
 
     # ---------------- deterministic lockstep counters ------------------------
     policy_steps_per_iter = num_envs  # only the player steps envs

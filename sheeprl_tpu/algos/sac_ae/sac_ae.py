@@ -107,7 +107,7 @@ def main(fabric: Any, cfg: Any) -> None:
     )
 
     aggregator = MetricAggregator(cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {})
-    timer.disabled = cfg.metric.disable_timer or cfg.metric.log_level == 0
+    timer.configure(cfg.metric)
 
     psync = PlayerSync(
         fabric, cfg, extract=lambda p: {"encoder": p["encoder"], "actor": p["actor"]}

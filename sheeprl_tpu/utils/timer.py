@@ -3,9 +3,17 @@
 Same role as the reference's ``timer`` ContextDecorator
 (reference: sheeprl/utils/timer.py:16-83): train loops wrap the env-interaction
 and train phases, and at log time derived steps-per-second throughputs are
-computed then timers reset.  JAX note: because dispatch is asynchronous, the
-train-phase wrapper calls ``block_until_ready`` on an optional sentinel array
-so measured time includes device execution.
+computed then timers reset.
+
+JAX note on attribution: dispatch is asynchronous, so by default a phase's
+measured time is its HOST time — device compute dispatched in the train
+phase that the host never waits for lands in whichever later phase first
+blocks (on a single-stream host that is usually the env phase's next
+device call).  ``metric.sync_timers=True`` (``timer.sync``) makes every
+timed phase drain the device at entry and exit, so phase times are
+attributable at the cost of losing host/device overlap — totals stay the
+same on a single-stream host, only the split moves.  bench captures turn
+it on; leave it off for throughput runs.
 """
 
 from __future__ import annotations
@@ -17,6 +25,7 @@ from typing import Any, ClassVar, Dict
 
 class timer(ContextDecorator):
     disabled: ClassVar[bool] = False
+    sync: ClassVar[bool] = False
     timers: ClassVar[Dict[str, float]] = {}
     _counts: ClassVar[Dict[str, int]] = {}
 
@@ -24,12 +33,43 @@ class timer(ContextDecorator):
         self.name = name
         self.mode = mode
 
+    @classmethod
+    def configure(cls, metric_cfg: Any) -> None:
+        """Apply the ``metric.*`` timing knobs (every train loop calls this)."""
+        cls.disabled = bool(
+            metric_cfg.disable_timer or metric_cfg.log_level == 0
+        )
+        cls.sync = bool(metric_cfg.get("sync_timers", False))
+
+    @staticmethod
+    def _drain_device() -> None:
+        """Block until every in-flight device computation has finished."""
+        try:
+            import jax
+
+            arrays = jax.live_arrays()
+        except Exception:
+            return  # timing must never take down the run
+        for a in arrays:
+            # donated inputs (donate_argnums train phases) may linger in
+            # live_arrays as deleted buffers — skip them, and keep draining
+            # the rest if any single array refuses to block
+            try:
+                if not getattr(a, "is_deleted", lambda: False)():
+                    a.block_until_ready()
+            except Exception:
+                continue
+
     def __enter__(self) -> "timer":
+        if timer.sync and not timer.disabled:
+            timer._drain_device()
         self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc: Any) -> bool:
         if not timer.disabled:
+            if timer.sync:
+                timer._drain_device()
             elapsed = time.perf_counter() - self._start
             if self.mode == "sum":
                 timer.timers[self.name] = timer.timers.get(self.name, 0.0) + elapsed

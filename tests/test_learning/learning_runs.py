@@ -49,6 +49,7 @@ WORKLOADS: Dict[str, dict] = {
             "algo.mlp_keys.encoder=[state]",
         ],
         "reward_threshold": 400.0,
+        "random_baseline": (25.6, 15.2),
         "falling_metric": None,
     },
     # Pendulum starts ~-1200/episode; SAC reaches better than -300 when the
@@ -68,13 +69,17 @@ WORKLOADS: Dict[str, dict] = {
             "buffer.size=20000",
         ],
         "reward_threshold": -300.0,
+        "random_baseline": (-1225.3, 268.2),
         "falling_metric": None,
     },
     # PIXEL learning teeth (VERDICT r3 weak #3): the agent's position exists
     # ONLY in the image (state key is zeros), so beating random proves the
     # CNN trunk carries the policy signal.  PixelGridDummyEnv: 4×4 grid,
-    # 16-step episodes, reward = -manhattan/6 per step — random ≈ -8/episode,
-    # a pixel-sighted policy ≥ -4.
+    # 16-step episodes, reward = -manhattan/6 per step.  Measured random
+    # baseline (100 episodes): -7.44 ± 3.17, so the mean over a ~25-episode
+    # gate window has σ ≈ 0.63 — the -3.0 gate is ~7σ above random while a
+    # pixel-sighted PPO reaches -0.8 (VERDICT r4 weak #4: gates re-derived
+    # from measured baselines).
     "ppo_pixel_grid": {
         "args": [
             "exp=ppo",
@@ -90,11 +95,13 @@ WORKLOADS: Dict[str, dict] = {
             "algo.cnn_keys.encoder=[rgb]",
             "algo.mlp_keys.encoder=[]",
         ],
-        "reward_threshold": -4.0,
+        "reward_threshold": -3.0,
+        "random_baseline": (-7.44, 3.17),
         "falling_metric": None,
     },
     # DreamerV3-XS on the same pixel task: CNN encoder/decoder + two-hot
-    # reward head must learn (obs loss falls, reward beats random).
+    # reward head must learn (obs loss falls, reward beats random):
+    # gate -4.5 ≈ +4.7σ above the random gate-window mean (-7.44, σ≈0.63).
     "dreamer_v3_pixel_grid": {
         "args": [
             "exp=dreamer_v3",
@@ -114,6 +121,7 @@ WORKLOADS: Dict[str, dict] = {
             "buffer.size=5000",
         ],
         "reward_threshold": -4.5,
+        "random_baseline": (-7.44, 3.17),
         "falling_metric": "Loss/observation_loss",
     },
     # REAL-PHYSICS teeth (VERDICT r4 missing #2): SAC on dm_control
@@ -165,7 +173,10 @@ WORKLOADS: Dict[str, dict] = {
             "algo.mlp_keys.encoder=[state]",
             "buffer.size=12000",
         ],
-        "reward_threshold": 120.0,  # random CartPole ≈ 20/episode
+        # 400 ≈ solved on the 500-max task (the r4 gate of 120 would not
+        # have caught a half-broken agent; measured run reaches 489.5)
+        "reward_threshold": 400.0,
+        "random_baseline": (25.6, 15.2),
         "falling_metric": "Loss/world_model_loss",
     },
 }

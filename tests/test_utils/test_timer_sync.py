@@ -1,0 +1,69 @@
+"""metric.sync_timers: phase-time ATTRIBUTION changes, totals don't.
+
+VERDICT r4 weak #5: with async dispatch, device compute launched in the
+train phase lands in whichever later phase first blocks, so
+``Time/sps_train`` was misleading on single-stream hosts.  Sync mode must
+move the time back into the dispatching phase without inflating the sum.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from sheeprl_tpu.utils.timer import timer
+
+
+@pytest.fixture(autouse=True)
+def _reset_timer_state():
+    saved = (timer.disabled, timer.sync, dict(timer.timers), dict(timer._counts))
+    timer.timers = {}
+    timer._counts = {}
+    yield
+    timer.disabled, timer.sync, timer.timers, timer._counts = saved
+
+
+@jax.jit
+def _heavy(x):
+    for _ in range(20):
+        x = x @ x / jnp.sqrt(jnp.float32(x.shape[0]))
+    return x
+
+
+def _run_phases(sync: bool):
+    timer.disabled = False
+    timer.sync = sync
+    timer.timers = {}
+    timer._counts = {}
+    x = jnp.ones((400, 400))
+    with timer("Time/train_time"):
+        y = _heavy(x)  # dispatched, not awaited — the realistic train phase
+    with timer("Time/env_interaction_time"):
+        y.block_until_ready()  # the next phase's first device touch
+    t = timer.to_dict(reset=True)
+    return t["Time/train_time"], t["Time/env_interaction_time"]
+
+
+def test_sync_mode_moves_compute_into_dispatching_phase():
+    _heavy(jnp.ones((400, 400))).block_until_ready()  # compile outside timing
+    train_async, env_async = _run_phases(sync=False)
+    train_sync, env_sync = _run_phases(sync=True)
+    # sync: the train phase owns (at least) its own device compute
+    assert train_sync > env_sync, (train_sync, env_sync)
+    assert train_sync > train_async, (train_sync, train_async)
+    # the total is the same work either way (generous bound: shared 1-core
+    # host; attribution moves ~all of the compute, totals only jitter)
+    total_async = train_async + env_async
+    total_sync = train_sync + env_sync
+    assert total_sync < 3.0 * total_async + 0.1, (total_sync, total_async)
+
+
+def test_configure_reads_sync_timers_flag():
+    class M(dict):
+        __getattr__ = dict.__getitem__
+
+    cfg = M(disable_timer=False, log_level=1, sync_timers=True)
+    timer.configure(cfg)
+    assert timer.sync is True and timer.disabled is False
+    cfg = M(disable_timer=False, log_level=0, sync_timers=False)
+    timer.configure(cfg)
+    assert timer.disabled is True and timer.sync is False
