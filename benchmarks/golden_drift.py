@@ -1,0 +1,152 @@
+"""Golden drift rehearsal across varied XLA-CPU configurations.
+
+VERDICT r4 weak #6: the goldens' foreign-platform tolerance (RTOL_FOREIGN)
+had never been validated against a second platform — the first TPU run
+would hit an untested tolerance.  This harness re-runs every golden family
+under varied XLA-CPU compilation configs in child processes (XLA_FLAGS must
+be set before jax initializes) and records the measured per-family drift
+against `goldens.json`, turning the tolerance into data.
+
+Usage:
+    JAX_PLATFORMS=cpu python benchmarks/golden_drift.py            # all configs
+    JAX_PLATFORMS=cpu python benchmarks/golden_drift.py --child <cfg>  # internal
+
+Writes `tests/test_regression/DRIFT.md`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO))
+
+GOLDENS = REPO / "tests" / "test_regression" / "goldens.json"
+OUT_MD = REPO / "tests" / "test_regression" / "DRIFT.md"
+
+# Each config is an XLA_FLAGS suffix appended to the inherited flags.
+# fast-math OFF is the interesting direction (XLA-CPU defaults it on, so
+# every golden was captured under fast-math); the thunk-runtime toggle
+# swaps the whole CPU executable layer, a proxy for "different XLA build".
+CONFIGS = {
+    "no_fast_math": "--xla_cpu_enable_fast_math=false",
+    "concurrency_1": "--xla_cpu_force_thunk_executor_concurrency=1",
+}
+
+
+def _child(cfg_name: str) -> None:
+    from sheeprl_tpu.utils.utils import force_cpu_backend
+
+    force_cpu_backend()
+    import tempfile
+
+    from sheeprl_tpu.cli import run
+    from tests.test_regression.test_golden import COMMON, FAMILIES, _last_metrics
+
+    results = {}
+    for family, args in sorted(FAMILIES.items()):
+        with tempfile.TemporaryDirectory() as tmp:
+            run(COMMON + args + [f"log_dir={tmp}/logs"])
+            results[family] = _last_metrics(Path(tmp))
+        print(f"[golden_drift:{cfg_name}] {family} done", file=sys.stderr, flush=True)
+    print("RESULTS " + json.dumps(results), flush=True)
+
+
+def _drift(got: dict, expected: dict) -> tuple:
+    """Max relative deviation over the shared metrics; returns (drift, name)."""
+    worst, worst_name = 0.0, "-"
+    for name in set(got) & set(expected):
+        e, g = expected[name], got[name]
+        rel = abs(g - e) / max(abs(e), 1e-5)
+        if rel > worst:
+            worst, worst_name = rel, name
+    return worst, worst_name
+
+
+def main() -> int:
+    if len(sys.argv) > 2 and sys.argv[1] == "--child":
+        _child(sys.argv[2])
+        return 0
+
+    goldens = json.loads(GOLDENS.read_text())
+    families = sorted(k for k in goldens if not k.startswith("__"))
+    table: dict = {}
+    for cfg_name, flags in CONFIGS.items():
+        env = {
+            **os.environ,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") + " " + flags).strip(),
+        }
+        print(f"[golden_drift] running config {cfg_name}: {flags}", flush=True)
+        proc = subprocess.run(
+            [sys.executable, __file__, "--child", cfg_name],
+            env=env,
+            capture_output=True,
+            text=True,
+            cwd=str(REPO),
+        )
+        line = next(
+            (l for l in proc.stdout.splitlines() if l.startswith("RESULTS ")), None
+        )
+        if proc.returncode != 0 or line is None:
+            print(
+                f"[golden_drift] {cfg_name} FAILED (rc={proc.returncode}):\n"
+                f"{proc.stderr[-2000:]}",
+                flush=True,
+            )
+            table[cfg_name] = None
+            continue
+        results = json.loads(line[len("RESULTS "):])
+        table[cfg_name] = {
+            fam: _drift(results.get(fam, {}), goldens[fam]) for fam in families
+        }
+
+    # ---- render -----------------------------------------------------------
+    import platform as _platform
+
+    import jax
+
+    lines = [
+        "# Golden drift across varied XLA-CPU configurations",
+        "",
+        "Measured by `benchmarks/golden_drift.py`: every golden family re-run",
+        "in a child process with the named `XLA_FLAGS` variation, max relative",
+        "deviation vs `goldens.json` over the golden metrics.  Context for the",
+        "tolerances in `test_golden.py`: same-config rtol "
+        "5e-3, foreign-platform rtol 5e-2.",
+        "",
+        f"Host: {_platform.machine()}/{_platform.system()}, jax {jax.__version__}.",
+        "",
+        "| family | " + " | ".join(table) + " |",
+        "|---|" + "---|" * len(table),
+    ]
+    for fam in families:
+        cells = []
+        for cfg_name in table:
+            if table[cfg_name] is None:
+                cells.append("config failed")
+                continue
+            drift, name = table[cfg_name][fam]
+            cells.append(f"{drift:.1e} ({name.removeprefix('Loss/')})" if name != "-" else "n/a")
+        lines.append(f"| {fam} | " + " | ".join(cells) + " |")
+    worst_overall = max(
+        (d for cfg in table.values() if cfg for d, _ in cfg.values()), default=0.0
+    )
+    lines += [
+        "",
+        f"Worst drift overall: **{worst_overall:.2e}** "
+        f"({'within' if worst_overall < 5e-2 else 'EXCEEDS'} the 5e-2 "
+        "foreign-platform tolerance).",
+        "",
+    ]
+    OUT_MD.write_text("\n".join(lines))
+    print(f"[golden_drift] wrote {OUT_MD} (worst {worst_overall:.2e})", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
