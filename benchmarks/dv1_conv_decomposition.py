@@ -142,9 +142,11 @@ def main() -> int:
     x_nhwc = jnp.asarray(rng.normal(size=(2500, 32, 32, 4)).astype(np.float32))
     k = jnp.asarray(rng.normal(size=(4, 4, 4, 2)).astype(np.float32))  # HWIO
 
+    pad = [(2, 2), (2, 2)]  # 4x4 kernel, stride-2 transposed conv -> exact 2x upsample
+
     def conv_nhwc(x, k):
         return jax.lax.conv_general_dilated(
-            x, k, window_strides=(1, 1), padding="SAME",
+            x, k, window_strides=(1, 1), padding=pad,
             lhs_dilation=(2, 2),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
         )
@@ -154,7 +156,7 @@ def main() -> int:
 
     def conv_nchw(x, k):
         return jax.lax.conv_general_dilated(
-            x, k, window_strides=(1, 1), padding="SAME",
+            x, k, window_strides=(1, 1), padding=pad,
             lhs_dilation=(2, 2),
             dimension_numbers=("NCHW", "OIHW", "NCHW"),
         )

@@ -31,6 +31,7 @@ from sheeprl_tpu.algos.dreamer_v2.loss import reconstruction_loss
 from sheeprl_tpu.algos.p2e_utils import ensemble_disagreement
 from sheeprl_tpu.utils.distribution import Bernoulli, Normal, OneHotCategorical
 from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import window_scan
 
 
 def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
@@ -346,7 +347,9 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
-        (p, o_state, _), metrics = jax.lax.scan(single_update, (p, o_state, counter0), (blocks, keys))
+        (p, o_state, _), metrics = window_scan(
+            single_update, (p, o_state, counter0), (blocks, keys), unroll=bool(cnn_keys)
+        )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
     return train_phase

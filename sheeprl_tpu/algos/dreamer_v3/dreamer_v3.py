@@ -58,7 +58,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs
+from sheeprl_tpu.utils.utils import Ratio, save_configs, window_scan
 
 
 def build_dv3_optimizers(fabric, cfg, params, saved_opt_state=None):
@@ -734,8 +734,8 @@ def make_train_phase(
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
-        (p, o_state, _), metrics = jax.lax.scan(
-            single_update, (p, o_state, counter0), (blocks, keys)
+        (p, o_state, _), metrics = window_scan(
+            single_update, (p, o_state, counter0), (blocks, keys), unroll=bool(cnn_keys)
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
     return train_phase

@@ -40,6 +40,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import compute_lambda_values, normalize_
 from sheeprl_tpu.utils.distribution import Bernoulli, OneHotCategorical, TwoHotEncodingDistribution
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
+from sheeprl_tpu.utils.utils import window_scan
 
 
 def build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state=None):
@@ -432,7 +433,9 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
-        (p, o_state, _), metrics = jax.lax.scan(single_update, (p, o_state, counter0), (blocks, keys))
+        (p, o_state, _), metrics = window_scan(
+            single_update, (p, o_state, counter0), (blocks, keys), unroll=bool(cnn_keys)
+        )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
     return train_phase

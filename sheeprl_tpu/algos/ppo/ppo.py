@@ -16,7 +16,9 @@ TPU-native architecture (not a port) — shaped by accelerator latency:
   (reference: sheeprl/algos/ppo/ppo_decoupled.py:32-365).
 * **One dispatch per optimization phase.**  The full update — GAE, epoch
   loop, minibatch permutations, clipped losses, Adam — is a single jitted
-  call (`lax.scan` over epochs × `lax.fori_loop` over minibatches) with
+  call (`lax.scan` over epochs × `lax.fori_loop` over minibatches on TPU;
+  both levels unroll at trace time on XLA-CPU, where outlined loop bodies
+  run ~5× slower — see `utils.window_scan`) with
   donated params: one host→device transfer of the rollout per iteration,
   one device→host transfer of the refreshed policy params.  The reference
   pays a DDP all-reduce + Python dispatch per minibatch instead.
@@ -52,7 +54,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
-from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs
+from sheeprl_tpu.utils.utils import gae, normalize_tensor, polynomial_decay, save_configs, should_unroll_updates, window_scan
 
 
 def epoch_permutation(
@@ -228,6 +230,11 @@ def main(fabric: Any, cfg: Any) -> None:
         flat["returns"] = returns.reshape(T * B)
         flat["advantages"] = advantages.reshape(T * B)
 
+        # XLA-CPU runs conv-bearing bodies ~5x slower inside outlined loops
+        # (scan/fori — see utils.window_scan); unroll BOTH update levels at
+        # trace time when the total body count is small enough to compile
+        unroll_updates = should_unroll_updates(cnn_keys, update_epochs * num_minibatches)
+
         def epoch_body(carry, key_e):
             p, o_state = carry
             perm = epoch_permutation(
@@ -245,13 +252,23 @@ def main(fabric: Any, cfg: Any) -> None:
                 p = optax.apply_updates(p, updates)
                 return p, o_state, (pg, vl, ent)
 
-            p, o_state, losses = jax.lax.fori_loop(
-                0, num_minibatches, mb_body, (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())))
-            )
+            carry2 = (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())))
+            if unroll_updates:
+                for i in range(num_minibatches):
+                    carry2 = mb_body(i, carry2)
+                p, o_state, losses = carry2
+            else:
+                p, o_state, losses = jax.lax.fori_loop(
+                    0, num_minibatches, mb_body, carry2
+                )
             return (p, o_state), losses
 
-        (p, o_state), losses = jax.lax.scan(
-            epoch_body, (p, o_state), jax.random.split(k, update_epochs)
+        (p, o_state), losses = window_scan(
+            epoch_body,
+            (p, o_state),
+            jax.random.split(k, update_epochs),
+            unroll_limit=32,
+            unroll=unroll_updates,
         )
         last_losses = jax.tree.map(lambda x: x[-1], losses)
         return p, o_state, last_losses

@@ -52,12 +52,13 @@ from sheeprl_tpu.utils.optim import build_optimizer, set_learning_rate
 from sheeprl_tpu.utils.utils import normalize_tensor, polynomial_decay
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import gae, save_configs
+from sheeprl_tpu.utils.utils import gae, save_configs, should_unroll_updates, window_scan
 
 
 def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type):
     """The jitted policy/value/train-phase programs shared by the pipelined
     (single-controller) and dedicated (cross-process) decoupled topologies."""
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
     reduction = cfg.algo.loss_reduction
     clip_vloss = bool(cfg.algo.clip_vloss)
     normalize_adv = bool(cfg.algo.normalize_advantages)
@@ -105,6 +106,10 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
         flat["returns"] = returns.reshape(T * B)
         flat["advantages"] = advantages.reshape(T * B)
 
+        # XLA-CPU outlined-loop penalty is conv-specific: see
+        # utils.window_scan / should_unroll_updates
+        unroll_updates = should_unroll_updates(cnn_keys, update_epochs * num_minibatches)
+
         def epoch_body(carry, key_e):
             p, o_state = carry
             perm = jax.random.permutation(key_e, T * B)
@@ -122,13 +127,22 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
                 p = optax.apply_updates(p, updates)
                 return p, o_state, (pg, vl, ent)
 
-            p, o_state, losses = jax.lax.fori_loop(
-                0, num_minibatches, mb_body,
-                (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(()))),
-            )
+            carry2 = (p, o_state, (jnp.zeros(()), jnp.zeros(()), jnp.zeros(())))
+            if unroll_updates:
+                for i in range(num_minibatches):
+                    carry2 = mb_body(i, carry2)
+                p, o_state, losses = carry2
+            else:
+                p, o_state, losses = jax.lax.fori_loop(0, num_minibatches, mb_body, carry2)
             return (p, o_state), losses
 
-        (p, o_state), losses = jax.lax.scan(epoch_body, (p, o_state), jax.random.split(k, update_epochs))
+        (p, o_state), losses = window_scan(
+            epoch_body,
+            (p, o_state),
+            jax.random.split(k, update_epochs),
+            unroll_limit=32,
+            unroll=unroll_updates,
+        )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
     return policy_step_fn, values_fn, train_phase

@@ -205,7 +205,12 @@ def main(fabric: Any, cfg: Any) -> None:
             )
             return (p, o_state), losses
 
-        (p, o_state), losses = jax.lax.scan(epoch_body, (p, o_state), jax.random.split(k, update_epochs))
+        # recurrent PPO is MLP-only (no conv trunk): the XLA-CPU
+        # outlined-loop penalty is conv-specific (utils.window_scan), so the
+        # compact scan/fori lowering stays unconditionally
+        (p, o_state), losses = jax.lax.scan(
+            epoch_body, (p, o_state), jax.random.split(k, update_epochs)
+        )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
     # ---------------- counters ----------------------------------------------

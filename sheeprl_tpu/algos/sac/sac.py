@@ -41,7 +41,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, TrainWindow, save_configs
+from sheeprl_tpu.utils.utils import Ratio, save_configs, TrainWindow, window_scan
 
 
 @register_algorithm()
@@ -130,8 +130,10 @@ def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha
         """``batches``: dict of (U, batch, ...) stacked update blocks."""
         U = batches["rewards"].shape[0]
         keys = jax.random.split(k, U)
-        (p, o_state, _), losses = jax.lax.scan(
-            one_update, (p, o_state, step0), (batches, keys)
+        # conv-free matmul body: scan carries no XLA-CPU penalty here, and
+        # SAC windows can be long — keep the compact lowering
+        (p, o_state, _), losses = window_scan(
+            one_update, (p, o_state, step0), (batches, keys), unroll=False
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 

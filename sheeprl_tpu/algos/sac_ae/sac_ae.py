@@ -38,7 +38,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, TrainWindow, save_configs
+from sheeprl_tpu.utils.utils import Ratio, save_configs, TrainWindow, window_scan
 
 
 def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array]:
@@ -263,7 +263,9 @@ def main(fabric: Any, cfg: Any) -> None:
     def train_phase(p, o_state, batches, k, step0):
         U = batches["rewards"].shape[0]
         keys = jax.random.split(k, U)
-        (p, o_state, _), losses = jax.lax.scan(one_update, (p, o_state, step0), (batches, keys))
+        (p, o_state, _), losses = window_scan(
+            one_update, (p, o_state, step0), (batches, keys), unroll=bool(cnn_keys)
+        )
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 
     # ---------------- counters / buffer --------------------------------------

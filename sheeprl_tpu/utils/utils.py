@@ -173,6 +173,50 @@ def safeatanh(x: jax.Array, eps: float = 1e-6) -> jax.Array:
     return jnp.arctanh(jnp.clip(x, -1.0 + eps, 1.0 - eps))
 
 
+def window_scan(body, carry, xs, unroll_limit: int = 16, unroll: bool = True):
+    """``lax.scan`` over an update window, UNROLLED as a traced Python loop
+    on the CPU backend for small convolution-bearing windows.
+
+    Measured on XLA-CPU (BENCH_CPU.md round 5): a convolution-bearing
+    update body runs ~5x slower inside ``lax.scan``'s outlined call (19.4 s
+    vs 3.5 s for the identical DreamerV1 benchmark-sized update; the
+    penalty is per iteration and ``lax.scan(..., unroll=True)`` does not
+    remove it — only true trace-time inlining does).  Pure-matmul bodies
+    show no such penalty, and unrolling them only inflates compile time
+    (the PPO CartPole benchmark DOUBLED from the bigger program), so
+    callers pass ``unroll=False`` for conv-free bodies.  On TPU the
+    outlined while-loop is the right lowering (compile time stays flat),
+    so scan is always kept there.
+
+    Compile cadence is unchanged either way: the window length already
+    participates in the input shape signature, so each distinct ``U``
+    compiled before and still does.
+    """
+    leaves = jax.tree.leaves(xs)
+    length = int(leaves[0].shape[0]) if leaves else 0
+    if any(l.shape[0] != length for l in leaves):  # keep lax.scan's guarantee
+        raise ValueError(
+            f"window_scan: inconsistent leading dims {[l.shape[0] for l in leaves]}"
+        )
+    backend = jax.default_backend()
+    if not unroll or backend != "cpu" or length == 0 or length > unroll_limit:
+        return jax.lax.scan(body, carry, xs)
+    ys = []
+    for u in range(length):
+        x_u = jax.tree.map(lambda v: v[u], xs)
+        carry, y = body(carry, x_u)
+        ys.append(y)
+    stacked = jax.tree.map(lambda *vs: jnp.stack(vs, 0), *ys)
+    return carry, stacked
+
+
+def should_unroll_updates(cnn_keys, n_bodies: int, limit: int = 32) -> bool:
+    """One source of truth for the PPO-family two-level unroll decision:
+    conv trunk present (the penalty is conv-specific), CPU backend, and a
+    total body count small enough to compile unrolled."""
+    return bool(cnn_keys) and jax.default_backend() == "cpu" and n_bodies <= limit
+
+
 # --------------------------------------------------------------------------
 # replay-ratio governor
 # --------------------------------------------------------------------------
