@@ -154,6 +154,39 @@ WORKLOADS: Dict[str, dict] = {
         "random_baseline": (32.9, 4.0),  # mean, std of 10 random-policy episodes
         "falling_metric": None,
     },
+    # DreamerV2 at XS-equivalent sizing on the same pixel task: the V2
+    # semantics (ELU, no unimix, alpha-balanced KL, Gaussian reward head,
+    # hard target copy, REINFORCE-mixed actor) must LEARN, not just pass
+    # goldens — same gate geometry as the DV3 pixel workload.
+    "dreamer_v2_pixel_grid": {
+        "args": [
+            "exp=dreamer_v2",
+            "env=dummy",
+            "env.id=pixel_grid_dummy",
+            "env.num_envs=1",
+            "env.sync_env=True",
+            "seed=5",
+            "algo.dense_units=256",
+            "algo.mlp_layers=1",
+            "algo.world_model.encoder.cnn_channels_multiplier=24",
+            "algo.world_model.recurrent_model.recurrent_state_size=256",
+            "algo.world_model.transition_model.hidden_size=256",
+            "algo.world_model.representation_model.hidden_size=256",
+            "algo.world_model.discrete_size=16",
+            "algo.world_model.stochastic_size=16",
+            "algo.total_steps=5000",
+            "algo.learning_starts=256",
+            "algo.replay_ratio=0.2",
+            "algo.per_rank_batch_size=4",
+            "algo.per_rank_sequence_length=16",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            "buffer.size=5000",
+        ],
+        "reward_threshold": -4.5,
+        "random_baseline": (-7.44, 3.17),
+        "falling_metric": "Loss/observation_loss",
+    },
     # DreamerV3-XS, vector obs only (no CNN => CPU-feasible): world-model
     # loss must fall AND reward must rise well above the random policy.
     "dreamer_v3_cartpole": {
