@@ -84,6 +84,23 @@ def _write_index(results: dict) -> None:
             "",
         ]
     )
+    partials_path = CURVES_DIR / "partials.json"
+    if partials_path.exists():
+        partials = json.loads(partials_path.read_text())
+        lines.extend(
+            [
+                "## Partial / exploratory runs (no gate claimed)",
+                "",
+                "| run | steps | final reward | random baseline | note |",
+                "|---|---|---|---|---|",
+            ]
+        )
+        for name, r in sorted(partials.items()):
+            lines.append(
+                f"| {name} | {r['steps']} | {r['final_reward']:.1f} "
+                f"| {r['random_baseline']} | {r['note']} |"
+            )
+        lines.append("")
     (CURVES_DIR / "LEARNING.md").write_text("\n".join(lines))
 
 
