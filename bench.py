@@ -95,15 +95,20 @@ def bench_dreamer_v3() -> dict:
     except Exception:
         pass  # cost analysis is best-effort; the throughput number still stands
 
-    # warmup (compile happens here only on the AOT-fallback path)
+    # warmup (compile happens here only on the AOT-fallback path).
+    # device_sync, NOT block_until_ready: the latter resolves at dispatch on
+    # the axon tunnel, which produced the phantom r5 first-capture numbers
+    # (BENCH_TPU.md timing-validity note).
+    from sheeprl_tpu.utils.utils import device_sync
+
     params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(0))
-    jax.block_until_ready(metrics)
+    device_sync((params, metrics))
 
     t0 = time.perf_counter()
-    iters = 3
+    iters = int(os.environ.get("BENCH_ITERS", 10))
     for i in range(iters):
         params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(i))
-    jax.block_until_ready(metrics)
+    device_sync((params, metrics))
     elapsed = time.perf_counter() - t0
     updates_per_s = (U * iters) / elapsed
     # The RTX-3080 baseline (0.5 updates/s) is for the S model on B=16, L=64

@@ -26,14 +26,15 @@ sys.path.insert(0, str(REPO))
 
 
 def _timed(fn, *args, n=5):
-    """Median wall-time of n calls, blocking on the result."""
-    import jax
+    """Median wall-time of n calls, blocking on the result via device_sync
+    (block_until_ready resolves at dispatch on the axon tunnel — BENCH_TPU.md)."""
+    from sheeprl_tpu.utils.utils import device_sync
 
-    fn(*args)  # warm/compile
+    device_sync(fn(*args))  # warm/compile
     times = []
     for _ in range(n):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        device_sync(fn(*args))
         times.append(time.perf_counter() - t0)
     return sorted(times)[len(times) // 2]
 

@@ -43,22 +43,18 @@ class timer(ContextDecorator):
 
     @staticmethod
     def _drain_device() -> None:
-        """Block until every in-flight device computation has finished."""
-        try:
-            import jax
+        """Block until every in-flight device computation has finished.
 
-            arrays = jax.live_arrays()
+        Uses ``utils.device_sync`` (D2H scalar materialization) rather than
+        ``block_until_ready``: the latter resolves at dispatch on the axon
+        tunnel platform, which would silently void sync-mode attribution
+        (BENCH_TPU.md timing-validity note)."""
+        try:
+            from sheeprl_tpu.utils.utils import device_sync
+
+            device_sync()
         except Exception:
             return  # timing must never take down the run
-        for a in arrays:
-            # donated inputs (donate_argnums train phases) may linger in
-            # live_arrays as deleted buffers — skip them, and keep draining
-            # the rest if any single array refuses to block
-            try:
-                if not getattr(a, "is_deleted", lambda: False)():
-                    a.block_until_ready()
-            except Exception:
-                continue
 
     def __enter__(self) -> "timer":
         if timer.sync and not timer.disabled:

@@ -344,6 +344,83 @@ def copy_cfg(cfg: Any) -> Any:
     return copy.deepcopy(cfg)
 
 
+def _untrusted_block_until_ready() -> bool:
+    """True when the active backend's ``block_until_ready`` resolves at
+    dispatch instead of completion (the axon tunnel PJRT plugin — detected
+    by its platform_version stamp), so timing fences must materialize a
+    value instead."""
+    try:
+        version = getattr(jax.devices()[0].client, "platform_version", "")
+    except Exception:
+        return False
+    return "axon" in version
+
+
+def device_sync(tree: Any = None) -> None:
+    """True device fence: block the host until device work has FINISHED.
+
+    ``jax.Array.block_until_ready`` resolves at *dispatch*, not completion,
+    on the axon tunnel PJRT plugin (BENCH_TPU.md timing-validity note), so
+    every wall-clock measurement must instead materialize a value that
+    depends on the work.  This fence slices one element from each leaf of
+    ``tree`` (or from every live array when ``tree`` is None), reduces them
+    to a single scalar in one device program per platform, and fetches that
+    scalar to host — per-device program ordering guarantees the fetch
+    returns only after every producer has executed.  Cost: one tiny D2H
+    transfer (~65 ms over the tunnel, ~µs on local backends).
+
+    On backends whose ``block_until_ready`` IS trustworthy (cpu / gpu /
+    directly-attached tpu), the drain-everything form (``tree is None``)
+    uses it directly: building token ops for thousands of live arrays
+    would cost more than the fence is worth there.
+    """
+    if tree is None:
+        leaves = list(jax.live_arrays())
+        if not _untrusted_block_until_ready():
+            for a in leaves:
+                # donated inputs may linger as deleted buffers — skip, and
+                # keep draining the rest if any single array refuses
+                try:
+                    if not a.is_deleted():
+                        a.block_until_ready()
+                except Exception:
+                    continue
+            return
+    else:
+        leaves = jax.tree_util.tree_leaves(tree)
+    groups: Dict[Any, list] = {}
+    for leaf in leaves:
+        if not isinstance(leaf, jax.Array):
+            continue
+        try:
+            if leaf.is_deleted():
+                continue
+            # group by exact device set: concatenating tokens committed to
+            # different devices (or shardings) would raise and silently void
+            # the fence on the one backend that needs it
+            key = tuple(sorted((d.platform, d.id) for d in leaf.devices()))
+            groups.setdefault(key, []).append(jnp.ravel(leaf)[:1].astype(jnp.float32))
+        except Exception:
+            continue
+    for toks in groups.values():
+        try:
+            tok = jnp.concatenate(toks) if len(toks) > 1 else toks[0]
+            np.asarray(tok.sum())
+        except Exception:
+            # the fence must never take down the run.  On the untrusted
+            # backend fall back to per-token materialization (slow but
+            # correct); elsewhere block_until_ready is fine.
+            untrusted = _untrusted_block_until_ready()
+            for t in toks:
+                try:
+                    if untrusted:
+                        np.asarray(t)
+                    else:
+                        t.block_until_ready()
+                except Exception:
+                    continue
+
+
 _ACCELERATOR_ALIVE: Optional[bool] = None
 
 # Cross-process probe cache: a wedged tunnel costs the 90 s subprocess probe
@@ -404,11 +481,12 @@ def accelerator_alive(timeout_s: int = 90) -> bool:
                 [
                     sys.executable,
                     "-c",
-                    # an actual dispatch, not just device enumeration: a
-                    # half-wedged tunnel can still LIST devices while any
-                    # real computation hangs forever
-                    "import jax, jax.numpy as jnp; jax.devices();"
-                    " (jnp.ones((8, 8)) * 2).block_until_ready()",
+                    # an actual dispatch MATERIALIZED to host, not just device
+                    # enumeration or block_until_ready: a half-wedged tunnel
+                    # can still LIST devices while computation hangs, and
+                    # block_until_ready resolves at dispatch on the tunnel
+                    "import jax, jax.numpy as jnp, numpy as np; jax.devices();"
+                    " assert float(np.asarray((jnp.ones((8, 8)) * 2).sum())) == 128.0",
                 ],
                 timeout=timeout_s,
                 capture_output=True,
