@@ -33,45 +33,62 @@ from sheeprl_tpu.ops.rssm_pallas import _reference_math as _rssm_reference
 xla_layernorm_gru = jax.jit(_gru_reference)
 
 
-def timeit(step, h0, iters=None):
-    """Time ``h = step(h)`` chained ``iters`` times, in microseconds/iter.
+def timeit(step, h0, iters=None, scan_len=None):
+    """Per-step microseconds of ``h = step(h)`` iterated inside ``lax.scan``.
 
-    Each dispatch is data-dependent on the previous one (no overlap, no
-    enqueue-rate artifacts) and completion is bounded by ``device_sync``
-    (D2H scalar materialization) — ``block_until_ready`` resolves at
-    dispatch on the axon tunnel, which produced the phantom first-capture
-    numbers (BENCH_TPU.md timing-validity note).  On TPU, iters
-    auto-scales so the chain runs >=0.5 s, amortizing the ~65 ms sync."""
+    Two layers of defense against tunnel measurement artifacts
+    (BENCH_TPU.md timing-validity note):
+
+    - the step runs under ``lax.scan`` in ONE jitted program per dispatch
+      (``scan_len`` steps each) — eager per-call timing measures the host's
+      ~200 µs dispatch rate, not a µs-scale kernel, and the scan is also
+      exactly how the RSSM consumes these kernels in training;
+    - completion is bounded by ``device_sync`` (D2H scalar materialization),
+      never ``block_until_ready`` (dispatch-time no-op on the tunnel).
+
+    Outer dispatches are chained (data-dependent) and auto-scaled so the
+    run dominates the ~65 ms sync floor."""
+    from functools import partial
+
+    from jax import lax
+
     from sheeprl_tpu.utils.utils import device_sync
 
-    h = step(h0)
+    on_tpu = jax.default_backend() == "tpu"
+    if scan_len is None:
+        # interpret-mode pallas on CPU is a correctness path, not a perf
+        # path — keep smoke runs short; real numbers need the TPU
+        scan_len = 256 if on_tpu else 2
+    scanned = jax.jit(
+        partial(
+            lambda n, h: lax.scan(lambda c, _: (step(c), None), h, None, length=n)[0],
+            scan_len,
+        )
+    )
+    h = scanned(h0)
     device_sync(h)
     calibrating = iters is None
     if calibrating:
-        # interpret-mode pallas on CPU is a correctness path, not a perf
-        # path — keep smoke runs short; real numbers need the TPU
-        iters = 200 if jax.default_backend() == "tpu" else 3
+        iters = 4 if on_tpu else 1
     t0 = time.perf_counter()
     h = h0
     for _ in range(iters):
-        h = step(h)
+        h = scanned(h)
     device_sync(h)
     dt = time.perf_counter() - t0
-    if calibrating and jax.default_backend() == "tpu":
-        # rescale until the chain dominates the ~65 ms sync floor — a single
-        # rescale from a sync-dominated probe would still return sync-bound
-        # per-iter times and flatten every speedup ratio toward 1.0
+    if calibrating and on_tpu:
+        # rescale until the chain dominates the sync floor
         attempts = 0
-        while dt < 0.5 and iters < 2_000_000 and attempts < 6:
+        while dt < 0.5 and iters < 100_000 and attempts < 6:
             iters = max(iters + 1, int(iters * 0.6 / max(dt, 1e-6)))
             t0 = time.perf_counter()
             h = h0
             for _ in range(iters):
-                h = step(h)
+                h = scanned(h)
             device_sync(h)
             dt = time.perf_counter() - t0
             attempts += 1
-    return dt / iters * 1e6  # us
+    return dt / (iters * scan_len) * 1e6  # us per step
 
 
 def main():

@@ -58,7 +58,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs, window_scan
+from sheeprl_tpu.utils.utils import Ratio, probe_bytes_per_update, save_configs, window_chunks, window_scan
 
 
 def build_dv3_optimizers(fabric, cfg, params, saved_opt_state=None):
@@ -270,6 +270,7 @@ def dreamer_family_loop(
     step_data["truncated"] = np.zeros((1, num_envs), np.float32)
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
+    bytes_per_update = None  # probed at the first train window (window_chunks)
     # per-rank player key stream, advanced inside player_step; the main
     # `key` stays rank-identical for train dispatches
     player_key = jax.device_put(jax.random.fold_in(key, rank), host)
@@ -393,38 +394,48 @@ def dreamer_family_loop(
                 per_rank_gradient_steps = 1 if update == total_iters else 0
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(
-                        batch_size,
-                        n_samples=per_rank_gradient_steps,
-                        sequence_length=seq_len,
-                    )  # (U, L, batch, *)
-                    blocks: Dict[str, jax.Array] = {}
-                    for k in cnn_keys:
-                        x = np.asarray(sample[k])
-                        if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
-                            u, l, b, s, h, w, c = x.shape
-                            x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u, l, b, h, w, s * c)
-                        # ship uint8 (4x less H2D traffic); the train phase
-                        # normalizes on device
-                        blocks[k] = jnp.asarray(x)
-                    for k in mlp_keys:
-                        x = np.asarray(sample[k], np.float32)
-                        blocks[k] = jnp.asarray(x.reshape(*x.shape[:3], -1))
-                    blocks["actions"] = jnp.asarray(np.asarray(sample["actions"], np.float32))
-                    blocks["rewards"] = jnp.asarray(np.asarray(sample["rewards"], np.float32)[..., 0])
-                    blocks["terminated"] = jnp.asarray(np.asarray(sample["terminated"], np.float32)[..., 0])
-                    blocks["is_first"] = jnp.asarray(np.asarray(sample["is_first"], np.float32)[..., 0])
-                    blocks = fabric.shard_batch(blocks, axis=2)
-                    # deferred sync AFTER the host-side sample/ship so that
-                    # work overlaps the tail of the previous window's device
-                    # compute (before_dispatch blocks on it — see PlayerSync)
-                    player_params = psync.before_dispatch(player_params)
-                    key, tk = jax.random.split(key)
-                    params, opt_state, last_metrics = train_phase(
-                        params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
-                    )
-                    grad_step_counter += per_rank_gradient_steps
-                    player_params = psync.after_dispatch(params, player_params)
+                    # burst windows (the first one repays every pre-training
+                    # env step at once) are split so no single sampled+shipped
+                    # (U, L, B, *) block can exceed the device byte budget —
+                    # see utils.window_chunks; steady-state windows stay
+                    # single-dispatch
+                    if bytes_per_update is None:
+                        bytes_per_update = probe_bytes_per_update(
+                            rb, batch_size, sequence_length=seq_len
+                        )
+                    for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
+                        sample = rb.sample(
+                            batch_size,
+                            n_samples=u,
+                            sequence_length=seq_len,
+                        )  # (U, L, batch, *)
+                        blocks: Dict[str, jax.Array] = {}
+                        for k in cnn_keys:
+                            x = np.asarray(sample[k])
+                            if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
+                                u_, l, b, s, h, w, c = x.shape
+                                x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u_, l, b, h, w, s * c)
+                            # ship uint8 (4x less H2D traffic); the train phase
+                            # normalizes on device
+                            blocks[k] = jnp.asarray(x)
+                        for k in mlp_keys:
+                            x = np.asarray(sample[k], np.float32)
+                            blocks[k] = jnp.asarray(x.reshape(*x.shape[:3], -1))
+                        blocks["actions"] = jnp.asarray(np.asarray(sample["actions"], np.float32))
+                        blocks["rewards"] = jnp.asarray(np.asarray(sample["rewards"], np.float32)[..., 0])
+                        blocks["terminated"] = jnp.asarray(np.asarray(sample["terminated"], np.float32)[..., 0])
+                        blocks["is_first"] = jnp.asarray(np.asarray(sample["is_first"], np.float32)[..., 0])
+                        blocks = fabric.shard_batch(blocks, axis=2)
+                        # deferred sync AFTER the host-side sample/ship so that
+                        # work overlaps the tail of the previous window's device
+                        # compute (before_dispatch blocks on it — see PlayerSync)
+                        player_params = psync.before_dispatch(player_params)
+                        key, tk = jax.random.split(key)
+                        params, opt_state, last_metrics = train_phase(
+                            params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
+                        )
+                        grad_step_counter += u
+                        player_params = psync.after_dispatch(params, player_params)
 
         # ---------------- logging ---------------------------------------------
         if cfg.metric.log_level > 0 and (

@@ -38,7 +38,7 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, save_configs, TrainWindow, window_scan
+from sheeprl_tpu.utils.utils import Ratio, probe_bytes_per_update, save_configs, TrainWindow, window_chunks, window_scan
 
 
 def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array]:
@@ -308,6 +308,7 @@ def main(fabric: Any, cfg: Any) -> None:
     # multi-host DP collects the same data num_processes times
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
+    bytes_per_update = None  # probed at the first train window (window_chunks)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
     player_key = jax.device_put(jax.random.fold_in(key, rank), host)
@@ -357,33 +358,40 @@ def main(fabric: Any, cfg: Any) -> None:
             )
             if per_rank_gradient_steps > 0:
                 with timer("Time/train_time"):
-                    sample = rb.sample(batch_size, n_samples=per_rank_gradient_steps)
-                    batches: Dict[str, jax.Array] = {
-                        "actions": jnp.asarray(sample["actions"]),
-                        "rewards": jnp.asarray(sample["rewards"][..., 0]),
-                        "terminated": jnp.asarray(sample["terminated"][..., 0]),
-                    }
-                    for k in cnn_keys:
-                        for src in (k, f"next_{k}"):
-                            x = np.asarray(sample[src])
-                            if x.ndim == 7:
-                                u, n_, b, s, h, w, c = x.shape
-                                x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u, n_, b, h, w, s * c)
-                            batches[src] = jnp.asarray(x)  # uint8; /255 on device
-                    for k in mlp_keys:
-                        for src in (k, f"next_{k}"):
-                            x = np.asarray(sample[src], np.float32)
-                            batches[src] = jnp.asarray(x.reshape(*x.shape[:2], -1))
-                    batches = fabric.shard_batch(batches, axis=1)
-                    # deferred sync AFTER the host-side sample/ship so that work
-                    # overlaps the tail of the previous window's device compute
-                    player_params = psync.before_dispatch(player_params)
-                    key, tk = jax.random.split(key)
-                    params, opt_state, last_losses = train_phase(
-                        params, opt_state, batches, tk, jnp.int32(grad_step_counter)
-                    )
-                    grad_step_counter += per_rank_gradient_steps
-                    player_params = psync.after_dispatch(params, player_params)
+                    # burst windows are split under a device byte budget
+                    # (utils.window_chunks) — pixel next_obs pairs double the
+                    # shipped bytes, so the first repaid window can otherwise
+                    # exceed HBM
+                    if bytes_per_update is None:
+                        bytes_per_update = probe_bytes_per_update(rb, batch_size)
+                    for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
+                        sample = rb.sample(batch_size, n_samples=u)
+                        batches: Dict[str, jax.Array] = {
+                            "actions": jnp.asarray(sample["actions"]),
+                            "rewards": jnp.asarray(sample["rewards"][..., 0]),
+                            "terminated": jnp.asarray(sample["terminated"][..., 0]),
+                        }
+                        for k in cnn_keys:
+                            for src in (k, f"next_{k}"):
+                                x = np.asarray(sample[src])
+                                if x.ndim == 7:
+                                    u_, n_, b, s, h, w, c = x.shape
+                                    x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u_, n_, b, h, w, s * c)
+                                batches[src] = jnp.asarray(x)  # uint8; /255 on device
+                        for k in mlp_keys:
+                            for src in (k, f"next_{k}"):
+                                x = np.asarray(sample[src], np.float32)
+                                batches[src] = jnp.asarray(x.reshape(*x.shape[:2], -1))
+                        batches = fabric.shard_batch(batches, axis=1)
+                        # deferred sync AFTER the host-side sample/ship so that work
+                        # overlaps the tail of the previous window's device compute
+                        player_params = psync.before_dispatch(player_params)
+                        key, tk = jax.random.split(key)
+                        params, opt_state, last_losses = train_phase(
+                            params, opt_state, batches, tk, jnp.int32(grad_step_counter)
+                        )
+                        grad_step_counter += u
+                        player_params = psync.after_dispatch(params, player_params)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run

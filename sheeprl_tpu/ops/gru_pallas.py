@@ -17,7 +17,14 @@ sharding composes).
 Use via ``fused_layernorm_gru(...)`` — numerically identical (fp32) to the
 flax cell; validated against it in tests/test_models/test_gru_pallas.py with
 ``interpret=True`` (no TPU needed).  Enable inside models with
-``LayerNormGRUCell(use_pallas=True)`` once on TPU hardware.
+``LayerNormGRUCell(use_pallas=True)``.
+
+HARDWARE STATUS (2026-07-31, v5e, honest scan-based timing — BENCH_TPU.md):
+Mosaic-compiles and matches the flax cell to <3e-6, but LOSES to XLA's
+fused scan body at every shape (speedup 0.38-0.56x; H=512/B=16: 11.3 µs vs
+XLA 4.5 µs per step) — XLA already keeps the scan working set VMEM-resident.
+RULING: XLA path stays the default; the kernel remains as a
+correctness-validated reference implementation.
 """
 
 from __future__ import annotations

@@ -37,11 +37,14 @@ Validated against the flax modules in tests/test_models/test_rssm_pallas.py
 with ``interpret=True`` (no TPU needed).  Enable inside the world model with
 ``algo.world_model.recurrent_model.fused_pallas=True`` once on TPU hardware.
 
-HARDWARE STATUS: interpret-mode-validated only — never Mosaic-compiled on a
-real TPU (the accelerator tunnel has been down since round 1; see
-benchmarks/tpu_revival.py, which A/Bs and compiles these kernels the moment
-it revives).  ``use_pallas``/``fused_pallas`` stay off by default until that
-run exists.  The VMEM planner (`_plan_tiled`) sizes the tiled variant's
+HARDWARE STATUS (2026-07-31, v5e, honest scan-based timing — BENCH_TPU.md):
+Mosaic-compiles and matches the XLA path to <1e-4 at every preset shape,
+but LOSES to XLA's fused scan body on all of them (speedup 0.18-0.47x;
+e.g. D=512/H=512/B=16: 13.2 µs vs XLA 4.4 µs per step).  XLA already keeps
+this working set in VMEM across scan iterations; the kernel's VMEM-residency
+premise buys nothing and its fp32 MXU path gives up bf16.  RULING:
+the XLA path stays the default; these kernels remain as correctness-validated
+reference implementations (`fused_pallas=True` still dispatches them).  The VMEM planner (`_plan_tiled`) sizes the tiled variant's
 working set against `_VMEM_WEIGHT_BUDGET_BYTES` and raises when no legal
 tiling fits, instead of letting Mosaic fail opaquely.
 """

@@ -210,6 +210,43 @@ def window_scan(body, carry, xs, unroll_limit: int = 16, unroll: bool = True):
     return carry, stacked
 
 
+def probe_bytes_per_update(rb, batch_size: int, **sample_kwargs) -> float:
+    """Host-side byte cost of ONE update's sampled batch (for window_chunks).
+
+    Draws a 1-update probe sample and sums leaf nbytes; snapshots/restores
+    the global numpy RNG so the probe does not shift the sampling stream
+    (goldens pin it).
+    """
+    rng_state = np.random.get_state()
+    try:
+        probe = rb.sample(batch_size, n_samples=1, **sample_kwargs)
+    finally:
+        np.random.set_state(rng_state)
+    return float(sum(np.asarray(v).nbytes for v in probe.values()))
+
+
+def window_chunks(n_updates: int, bytes_per_update: float, budget_bytes: Optional[float] = None):
+    """Split an update window into dispatch chunk sizes whose shipped
+    ``(U, ...)`` batch block stays under a device byte budget.
+
+    The first window after ``learning_starts`` is a burst: the ratio
+    governor repays every pre-training env step at once, so e.g.
+    ``learning_starts=1024`` at replay_ratio 1 demands U=1024 — sampled and
+    shipped as ONE uint8 block that is 12.9 GiB raw / 25.8 GiB in padded
+    TPU layout for (1024, 64, 16, 64, 64, 3), over a 16 GiB HBM chip
+    (the round-5 TPU learning capture died on exactly that alloc).
+    Chunking caps per-dispatch block bytes; steady-state windows are far
+    below the budget and stay single-dispatch.  Budget default 1 GiB
+    (override ``SHEEPRL_MAX_WINDOW_BYTES``) — the padded-layout worst case
+    observed is 2x raw, leaving ample HBM for params/activations.
+    """
+    if budget_bytes is None:
+        budget_bytes = float(os.environ.get("SHEEPRL_MAX_WINDOW_BYTES", 2**30))
+    max_u = max(1, int(budget_bytes // max(bytes_per_update, 1.0)))
+    full, rem = divmod(int(n_updates), max_u)
+    return [max_u] * full + ([rem] if rem else [])
+
+
 def should_unroll_updates(cnn_keys, n_bodies: int, limit: int = 32) -> bool:
     """One source of truth for the PPO-family two-level unroll decision:
     conv trunk present (the penalty is conv-specific), CPU backend, and a

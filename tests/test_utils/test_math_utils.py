@@ -119,3 +119,35 @@ class TestRatio:
             Ratio(-1.0)
         with pytest.raises(ValueError):
             Ratio(1.0, pretrain_steps=-1)
+
+
+class TestWindowChunks:
+    """utils.window_chunks: burst update windows are split under a device
+    byte budget so the first post-learning_starts dispatch can't exceed HBM
+    (the r5 TPU learning capture OOMed on a single 25.8 GiB padded block)."""
+
+    def test_steady_state_single_chunk(self):
+        from sheeprl_tpu.utils.utils import window_chunks
+
+        assert window_chunks(1, 1e6) == [1]
+        assert window_chunks(4, 1e6) == [4]
+
+    def test_burst_split_and_total_preserved(self):
+        from sheeprl_tpu.utils.utils import window_chunks
+
+        # DV3-S walker-walk shape: ~12.6 MB/update, 1 GiB budget -> 85/chunk
+        chunks = window_chunks(1024, 12.6e6)
+        assert sum(chunks) == 1024
+        assert max(chunks) * 12.6e6 <= 2**30
+        assert len(set(chunks[:-1])) <= 1  # uniform full chunks, one remainder
+
+    def test_budget_env_override(self, monkeypatch):
+        from sheeprl_tpu.utils.utils import window_chunks
+
+        monkeypatch.setenv("SHEEPRL_MAX_WINDOW_BYTES", "100")
+        assert window_chunks(10, 50.0) == [2, 2, 2, 2, 2]
+
+    def test_huge_per_update_never_zero(self):
+        from sheeprl_tpu.utils.utils import window_chunks
+
+        assert window_chunks(3, 1e12) == [1, 1, 1]
