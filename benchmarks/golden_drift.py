@@ -40,18 +40,27 @@ CONFIGS = {
 
 
 def _child(cfg_name: str) -> None:
-    from sheeprl_tpu.utils.utils import force_cpu_backend
-
-    force_cpu_backend()
     import tempfile
 
     from sheeprl_tpu.cli import run
     from tests.test_regression.test_golden import COMMON, FAMILIES, _last_metrics
 
+    common = list(COMMON)
+    if cfg_name == "tpu_chip":
+        # run the SAME golden recipes on the real chip: ambient (axon)
+        # backend, fp32 params — the drift measured is platform numerics
+        # (MXU matmul path, conv layout), exactly what RTOL_FOREIGN guards
+        common = [a for a in common if a != "fabric.accelerator=cpu"]
+        common.append("fabric.accelerator=tpu")
+    else:
+        from sheeprl_tpu.utils.utils import force_cpu_backend
+
+        force_cpu_backend()
+
     results = {}
     for family, args in sorted(FAMILIES.items()):
         with tempfile.TemporaryDirectory() as tmp:
-            run(COMMON + args + [f"log_dir={tmp}/logs"])
+            run(common + args + [f"log_dir={tmp}/logs"])
             results[family] = _last_metrics(Path(tmp))
         print(f"[golden_drift:{cfg_name}] {family} done", file=sys.stderr, flush=True)
     print("RESULTS " + json.dumps(results), flush=True)
@@ -70,13 +79,77 @@ def _drift(got: dict, expected: dict) -> tuple:
     return worst, worst_name, len(shared)
 
 
+def _tpu_mode() -> int:
+    """Run the golden families once on the real chip and APPEND a
+    second-platform drift table to DRIFT.md (the CPU-config table the
+    main mode writes is left untouched)."""
+    goldens = json.loads(GOLDENS.read_text())
+    families = sorted(k for k in goldens if not k.startswith("__"))
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    proc = subprocess.run(
+        [sys.executable, __file__, "--child", "tpu_chip"],
+        env=env,
+        capture_output=True,
+        text=True,
+        cwd=str(REPO),
+    )
+    line = next((l for l in proc.stdout.splitlines() if l.startswith("RESULTS ")), None)
+    if proc.returncode != 0 or line is None:
+        print(
+            f"[golden_drift] tpu_chip FAILED (rc={proc.returncode}):\n{proc.stderr[-3000:]}",
+            flush=True,
+        )
+        return 1
+    results = json.loads(line[len("RESULTS "):])
+    rows = {fam: _drift(results.get(fam, {}), goldens[fam]) for fam in families}
+    worst = max((d for d, _, _ in rows.values()), default=0.0)
+    lines = [
+        "",
+        "## Second platform: real TPU (v5e, axon)",
+        "",
+        "Same golden recipes, `fabric.accelerator=tpu`, fp32 params, default",
+        "TPU matmul precision.  Max relative deviation vs the CPU-captured",
+        "`goldens.json`:",
+        "",
+        "| family | drift (worst metric) |",
+        "|---|---|",
+    ]
+    for fam in families:
+        drift, name, n = rows[fam]
+        if n == 0:
+            lines.append(f"| {fam} | NO METRICS |")
+        else:
+            lines.append(f"| {fam} | {drift:.1e} ({name.removeprefix('Loss/')}, {n} metrics) |")
+    lines += [
+        "",
+        f"Worst TPU drift: **{worst:.2e}** "
+        f"({'within' if worst < 5e-2 else 'EXCEEDS'} the 5e-2 foreign-platform tolerance).",
+        "",
+    ]
+    # idempotent append: drop any previous TPU section (re-runs must not
+    # stack duplicates), keep the CPU-config table above it
+    marker = "\n## Second platform: real TPU"
+    existing = OUT_MD.read_text() if OUT_MD.exists() else ""
+    if marker in existing:
+        existing = existing[: existing.index(marker)]
+    OUT_MD.write_text(existing + "\n".join(lines))
+    print(f"[golden_drift] appended TPU table to {OUT_MD} (worst {worst:.2e})", flush=True)
+    return 0
+
+
 def main() -> int:
     if len(sys.argv) > 2 and sys.argv[1] == "--child":
         _child(sys.argv[2])
         return 0
+    if "--tpu" in sys.argv:
+        return _tpu_mode()
 
     goldens = json.loads(GOLDENS.read_text())
     families = sorted(k for k in goldens if not k.startswith("__"))
+    # preserve a committed TPU section across CPU-mode rewrites
+    tpu_marker = "\n## Second platform: real TPU"
+    prior = OUT_MD.read_text() if OUT_MD.exists() else ""
+    tpu_section = prior[prior.index(tpu_marker):] if tpu_marker in prior else ""
     table: dict = {}
     for cfg_name, flags in CONFIGS.items():
         env = {
@@ -160,7 +233,7 @@ def main() -> int:
         "the tight gate).",
         "",
     ]
-    OUT_MD.write_text("\n".join(lines))
+    OUT_MD.write_text("\n".join(lines) + tpu_section)
     print(f"[golden_drift] wrote {OUT_MD} (worst {worst_overall:.2e})", flush=True)
     return 0
 

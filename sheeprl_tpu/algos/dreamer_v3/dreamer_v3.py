@@ -36,6 +36,7 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
 from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
+    merge_framestack,
     compute_lambda_values,
     moments_update,
     normalize_obs_block,
@@ -228,6 +229,34 @@ def dreamer_family_loop(
             memmap=cfg.buffer.memmap,
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
         )
+    # device-resident pixel mirror: sampled pixel sequences are gathered on
+    # device instead of shipped per window (buffers.DeviceMirror).  Budget
+    # check against the known obs shapes; silently stays off when the ring
+    # would not fit (or for the EpisodeBuffer layout, which has no ring).
+    mirror_on = (
+        bool(cfg.buffer.get("device_mirror", False))
+        and bool(cnn_keys)
+        and isinstance(rb, EnvIndependentReplayBuffer)
+    )
+    if mirror_on:
+        ring_bytes = sum(
+            rb._buffer_size
+            * num_envs
+            * int(np.prod(obs_space[k].shape))
+            * np.dtype(obs_space[k].dtype).itemsize
+            for k in cnn_keys
+        )
+        budget = float(os.environ.get("SHEEPRL_MIRROR_BUDGET_BYTES", 6 * 2**30))
+        if ring_bytes <= budget:
+            rb.attach_mirror(cnn_keys)
+        else:
+            mirror_on = False
+            print(
+                f"[sheeprl_tpu] buffer.device_mirror disabled: pixel ring needs "
+                f"{ring_bytes / 2**30:.1f} GiB > budget {budget / 2**30:.1f} GiB "
+                "(set SHEEPRL_MIRROR_BUDGET_BYTES to raise)",
+                flush=True,
+            )
     # a checkpoint only contains "rb" if it was saved with buffer.checkpoint
     # (or injected explicitly, e.g. P2E finetuning's load_from_exploration) —
     # so presence alone decides
@@ -404,17 +433,33 @@ def dreamer_family_loop(
                             rb, batch_size, sequence_length=seq_len
                         )
                     for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
+                        # with the device mirror, pixel keys never cross the
+                        # host->device link: the host samples only the small
+                        # keys (and the ring coordinates), the device gathers
+                        # the pixel sequences from its mirrored ring
+                        sample_keys = (
+                            tuple(mlp_keys) + ("actions", "rewards", "terminated", "is_first")
+                            if mirror_on
+                            else None
+                        )
                         sample = rb.sample(
                             batch_size,
                             n_samples=u,
                             sequence_length=seq_len,
+                            keys=sample_keys,
                         )  # (U, L, batch, *)
                         blocks: Dict[str, jax.Array] = {}
                         for k in cnn_keys:
+                            if mirror_on:
+                                t_idx, e_idx = rb.last_sample_indices
+                                x = rb.mirror.gather(k, t_idx, e_idx)
+                                if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
+                                    x = merge_framestack(x, jnp)
+                                blocks[k] = x
+                                continue
                             x = np.asarray(sample[k])
                             if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
-                                u_, l, b, s, h, w, c = x.shape
-                                x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u_, l, b, h, w, s * c)
+                                x = merge_framestack(x)
                             # ship uint8 (4x less H2D traffic); the train phase
                             # normalizes on device
                             blocks[k] = jnp.asarray(x)

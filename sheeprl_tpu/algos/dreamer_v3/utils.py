@@ -122,6 +122,17 @@ def test(
     return cum_reward
 
 
+def merge_framestack(x, xp=np):
+    """``(..., S, H, W, C)`` framestacked pixels -> ``(..., H, W, S*C)``.
+
+    One source of truth for the stack-to-channels layout every pixel train
+    path uses (host-shipped blocks pass ``xp=np``; device-mirror gathers
+    pass ``xp=jnp`` so the permute runs on device)."""
+    s = x.shape
+    x = xp.moveaxis(x, -4, -2)  # (..., H, W, S, C)
+    return x.reshape(*s[:-4], s[-3], s[-2], s[-4] * s[-1])
+
+
 def normalize_obs_block(data, cnn_keys, obs_keys, offset: float = 0.5):
     """Device-side observation normalization of a uint8-shipped replay block:
     images → float/255 − offset, vectors → float (the jit-side twin of

@@ -28,7 +28,7 @@ import optax
 
 from sheeprl_tpu.algos.sac.agent import ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_block
+from sheeprl_tpu.algos.dreamer_v3.utils import merge_framestack, normalize_obs_block
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.parallel.fabric import PlayerSync
@@ -46,8 +46,7 @@ def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array
     for k in cnn_keys:
         x = np.asarray(obs[k])
         if x.ndim == 5:
-            b, s, h, w, c = x.shape
-            x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
+            x = merge_framestack(x)
         out[k] = jnp.asarray(x, jnp.float32) / 255.0
     for k in mlp_keys:
         out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(np.asarray(obs[k]).shape[0], -1))
@@ -375,8 +374,7 @@ def main(fabric: Any, cfg: Any) -> None:
                             for src in (k, f"next_{k}"):
                                 x = np.asarray(sample[src])
                                 if x.ndim == 7:
-                                    u_, n_, b, s, h, w, c = x.shape
-                                    x = np.transpose(x, (0, 1, 2, 4, 5, 3, 6)).reshape(u_, n_, b, h, w, s * c)
+                                    x = merge_framestack(x)
                                 batches[src] = jnp.asarray(x)  # uint8; /255 on device
                         for k in mlp_keys:
                             for src in (k, f"next_{k}"):

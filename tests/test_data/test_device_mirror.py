@@ -1,0 +1,122 @@
+"""DeviceMirror: device-gathered pixel sequences == host-sampled ones.
+
+The mirror (data/buffers.py:DeviceMirror) keeps a device-resident uint8
+ring of the pixel keys and gathers sampled sequences on device, so pixel
+blocks never cross the host->device link during training.  Correctness
+contract: for the SAME host sampling draw, the mirror gather must be
+bit-identical to the host gather — these tests drive wrap-around,
+divergent per-env streams (reset rows via ``indices=``), attach-time
+sync of pre-filled rings, and checkpoint-resume resync.
+"""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, SequentialReplayBuffer
+
+
+def _step(t, n_envs=2, hw=8):
+    """Deterministic, distinguishable frame content per (t, env)."""
+    rgb = np.zeros((1, n_envs, hw, hw, 3), np.uint8)
+    for e in range(n_envs):
+        rgb[0, e] = (t * 7 + e * 31) % 256
+    return {
+        "rgb": rgb,
+        "rewards": np.full((1, n_envs), float(t), np.float32),
+    }
+
+
+def _mk(size=16, n_envs=2):
+    rb = EnvIndependentReplayBuffer(size, n_envs=n_envs, buffer_cls=SequentialReplayBuffer)
+    rb.attach_mirror(["rgb"])
+    return rb
+
+
+def _assert_mirror_matches(rb, batch_size=3, n_samples=2, seq_len=4):
+    state = np.random.get_state()
+    host = rb.sample(batch_size, n_samples=n_samples, sequence_length=seq_len)
+    np.random.set_state(state)
+    rb.sample(
+        batch_size, n_samples=n_samples, sequence_length=seq_len, keys=("rewards",)
+    )
+    t_idx, e_idx = rb.last_sample_indices
+    got = np.asarray(rb.mirror.gather("rgb", t_idx, e_idx))
+    np.testing.assert_array_equal(got, host["rgb"])
+
+
+def test_mirror_matches_host_basic():
+    np.random.seed(3)
+    rb = _mk()
+    for t in range(10):
+        rb.add(_step(t))
+    _assert_mirror_matches(rb)
+
+
+def test_mirror_matches_after_wraparound():
+    np.random.seed(4)
+    rb = _mk(size=8)
+    for t in range(37):  # several full wraps of the size-8 ring
+        rb.add(_step(t))
+    _assert_mirror_matches(rb, seq_len=3)
+
+
+def test_mirror_matches_with_divergent_env_streams():
+    """Reset rows (``indices=[e]``) advance one env's ring ahead of the
+    other — the mirror must track per-env write positions."""
+    np.random.seed(5)
+    rb = _mk(size=12)
+    for t in range(9):
+        rb.add(_step(t))
+        if t % 3 == 0:  # extra row for env 1 only
+            rb.add({k: v[:, 1:2] for k, v in _step(100 + t).items()}, indices=[1])
+    assert len(rb.buffer[0]) != len(rb.buffer[1])
+    _assert_mirror_matches(rb, seq_len=3)
+
+
+def test_attach_syncs_prefilled_ring():
+    np.random.seed(6)
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    for t in range(13):  # includes a wrap before the mirror exists
+        rb.add(_step(t))
+    rb.attach_mirror(["rgb"])
+    _assert_mirror_matches(rb, seq_len=3)
+
+
+def test_resume_resyncs_mirror():
+    np.random.seed(7)
+    rb = _mk(size=8)
+    for t in range(6):
+        rb.add(_step(t))
+    state = rb.state_dict()
+    rb2 = _mk(size=8)
+    rb2.load_state_dict(state)
+    _assert_mirror_matches(rb2, seq_len=3)
+
+
+def test_attach_requires_sequential_sub_buffers():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=ReplayBuffer)
+    with pytest.raises(ValueError):
+        rb.attach_mirror(["rgb"])
+
+
+@pytest.mark.slow
+def test_dreamer_e2e_mirror_equivalence(tmp_path):
+    """Full DV3-XS dry run with the mirror ON equals the host-ship path
+    bit-for-bit: same RNG draws (the keys filter does not change the
+    sampling stream), same pixel bytes (gathered on device vs shipped),
+    so identical losses."""
+    from tests.test_regression.test_golden import COMMON, FAMILIES, _last_metrics
+    from sheeprl_tpu.cli import run
+
+    results = {}
+    for mirror in ("False", "True"):
+        logs = tmp_path / f"mirror_{mirror}"
+        run(
+            COMMON
+            + FAMILIES["dreamer_v3"]
+            + [f"buffer.device_mirror={mirror}", f"log_dir={logs}"]
+        )
+        results[mirror] = _last_metrics(logs)
+    assert results["False"] and results["False"] == results["True"]

@@ -39,6 +39,14 @@ ATOL = 1e-5
 # goldens, chaotic metrics (e.g. Loss/observation_loss ~4e3) can drift past
 # RTOL without any code change (ADVICE r3): widen instead of flaking.
 RTOL_FOREIGN = 5e-2
+# Cancellation-prone metrics: a difference of O(k) constituents can show a
+# large RELATIVE drift from ordinary platform numerics (DRIFT.md measured
+# every sac_ae constituent at 3-5% on the real TPU; policy_loss = alpha*logp
+# - min(Q) lands near zero, so that 3.5% becomes 62% relative).  A narrow,
+# data-backed ABSOLUTE allowance per metric — never a blanket widening.
+ATOL_FOREIGN = {
+    "sac_ae:Loss/policy_loss": 0.1,
+}
 
 
 def _env_stamp() -> dict:
@@ -48,6 +56,9 @@ def _env_stamp() -> dict:
         "jax": jax.__version__,
         "machine": platform.machine(),
         "system": platform.system(),
+        # the backend IS part of the platform: TPU-vs-CPU drift is exactly
+        # what RTOL_FOREIGN exists for (DRIFT.md second-platform table)
+        "backend": jax.default_backend(),
     }
 
 COMMON = [
@@ -240,6 +251,8 @@ def test_golden_train_step(tmp_path, family):
     rtol = RTOL
     stamps = goldens.get("__env__") or {}
     recorded_env = stamps.get(family) if isinstance(stamps, dict) and "jax" not in stamps else stamps
+    if recorded_env is not None and "backend" not in recorded_env:
+        recorded_env = {**recorded_env, "backend": "cpu"}  # legacy stamps: CPU-captured
     if recorded_env is not None and recorded_env != _env_stamp():
         rtol = RTOL_FOREIGN
         import warnings
@@ -255,7 +268,10 @@ def test_golden_train_step(tmp_path, family):
     )
     for name, want in expected.items():
         have = got[name]
-        assert have == pytest.approx(want, rel=rtol, abs=ATOL), (
+        atol = ATOL
+        if rtol == RTOL_FOREIGN:
+            atol = max(ATOL, ATOL_FOREIGN.get(f"{family}:{name}", 0.0))
+        assert have == pytest.approx(want, rel=rtol, abs=atol), (
             f"{family}: {name} = {have!r}, golden {want!r} — numeric behavior changed; "
             "if intended, GOLDEN_REGEN=1 and review the diff"
         )
