@@ -103,6 +103,21 @@ def _tpu_mode() -> int:
     results = json.loads(line[len("RESULTS "):])
     rows = {fam: _drift(results.get(fam, {}), goldens[fam]) for fam in families}
     worst = max((d for d, _, _ in rows.values()), default=0.0)
+    # the gate verdict must apply the SAME rule test_golden does: rel within
+    # RTOL_FOREIGN, or abs within the metric's documented ATOL_FOREIGN
+    # carve-out (cancellation-prone metrics)
+    from tests.test_regression.test_golden import ATOL, ATOL_FOREIGN, RTOL_FOREIGN
+
+    failures = []
+    for fam in families:
+        for name, want in goldens[fam].items():
+            have = results.get(fam, {}).get(name)
+            if have is None:
+                continue
+            delta = abs(have - want)
+            atol = max(ATOL, ATOL_FOREIGN.get(f"{fam}:{name}", 0.0))
+            if delta > RTOL_FOREIGN * abs(want) and delta > atol:
+                failures.append(f"{fam}:{name}")
     lines = [
         "",
         "## Second platform: real TPU (v5e, axon)",
@@ -120,10 +135,15 @@ def _tpu_mode() -> int:
             lines.append(f"| {fam} | NO METRICS |")
         else:
             lines.append(f"| {fam} | {drift:.1e} ({name.removeprefix('Loss/')}, {n} metrics) |")
+    verdict = (
+        "**gate GREEN** (every metric within rtol 5e-2 or its documented "
+        "ATOL_FOREIGN carve-out)"
+        if not failures
+        else f"**gate RED**: {', '.join(failures)} outside both tolerances"
+    )
     lines += [
         "",
-        f"Worst TPU drift: **{worst:.2e}** "
-        f"({'within' if worst < 5e-2 else 'EXCEEDS'} the 5e-2 foreign-platform tolerance).",
+        f"Worst relative drift: **{worst:.2e}**.  test_golden foreign gate: {verdict}.",
         "",
     ]
     # idempotent append: drop any previous TPU section (re-runs must not
@@ -134,7 +154,8 @@ def _tpu_mode() -> int:
         existing = existing[: existing.index(marker)]
     OUT_MD.write_text(existing + "\n".join(lines))
     print(f"[golden_drift] appended TPU table to {OUT_MD} (worst {worst:.2e})", flush=True)
-    return 0
+    # a RED gate must fail the stage (tpu_revival records rc==0 as ok)
+    return 1 if failures else 0
 
 
 def main() -> int:

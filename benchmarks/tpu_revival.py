@@ -39,27 +39,43 @@ STATE = REPO / "benchmarks" / ".tpu_revival_state.json"
 LOCK = pathlib.Path("/tmp/tpu_revival.lock")
 
 STAGES = [
-    # (name, argv, extra env, timeout seconds)
+    # (name, argv, extra env, timeout seconds).  Honest-timing era (r5s3):
+    # every timed stage goes through utils.device_sync — see BENCH_TPU.md's
+    # timing-validity note for why block_until_ready cannot be trusted here.
     (
-        "dv3_s_bench",
+        "dv3_s_bench_honest",
         [sys.executable, "bench.py"],
-        {"BENCH_TIMEOUT": "1800"},
+        {"BENCH_TIMEOUT": "1800", "BENCH_ITERS": "10"},
         2100,
     ),
     (
-        "pallas_ab",
+        "pallas_ab_scan",
         [sys.executable, "benchmarks/bench_gru_pallas.py"],
         {},
-        1800,
+        3000,
     ),
     (
-        "xl_shape_check",
+        "xl_shape_check_honest",
         [sys.executable, "bench.py"],
-        {"BENCH_SIZE": "XL", "BENCH_B": "8", "BENCH_L": "32", "BENCH_U": "1", "BENCH_TIMEOUT": "1800"},
-        2100,
+        {"BENCH_SIZE": "XL", "BENCH_B": "8", "BENCH_L": "32", "BENCH_U": "1",
+         "BENCH_ITERS": "6", "BENCH_TIMEOUT": "2400"},
+        2500,
     ),
     (
-        "dv3_s_dmc_partial_learning",
+        "golden_drift_tpu",
+        [sys.executable, "benchmarks/golden_drift.py", "--tpu"],
+        {},
+        3500,
+    ),
+    (
+        "dreamer_v3_wall_on_chip",
+        [sys.executable, "bench.py"],
+        {"BENCH_TARGET": "dreamer_v3_wall", "BENCH_ON_ACCEL": "1",
+         "BENCH_TIMEOUT": "3600"},
+        3700,
+    ),
+    (
+        "dv3_s_dmc_learning",
         [
             sys.executable,
             "-m",
@@ -70,16 +86,19 @@ STAGES = [
             "algo.learning_starts=1024",
             "algo.run_test=False",
             "env.num_envs=1",
+            "buffer.size=25000",
+            "buffer.device_mirror=True",
+            "buffer.memmap=False",
             "metric.log_level=1",
             "metric/logger=csv",
             "metric.log_every=500",
             "checkpoint.every=0",
-            "checkpoint.save_last=False",
+            "checkpoint.save_last=True",
             "print_config=False",
             "log_dir=/tmp/tpu_revival_learning",
         ],
         {"MUJOCO_GL": "egl"},
-        2400,  # hard 40-min ceiling; whatever it reached is the datapoint
+        2900,  # whatever it reached is the datapoint
     ),
 ]
 

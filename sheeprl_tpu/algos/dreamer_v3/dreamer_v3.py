@@ -36,7 +36,6 @@ import optax
 from sheeprl_tpu.algos.dreamer_v3.agent import WorldModel, build_agent
 from sheeprl_tpu.algos.dreamer_v3.loss import world_model_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import (
-    merge_framestack,
     compute_lambda_values,
     moments_update,
     normalize_obs_block,
@@ -44,7 +43,7 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
 )
 from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer, maybe_attach_mirror
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
@@ -59,7 +58,14 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, probe_bytes_per_update, save_configs, window_chunks, window_scan
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    merge_framestack,
+    probe_bytes_per_update,
+    save_configs,
+    window_chunks,
+    window_scan,
+)
 
 
 def build_dv3_optimizers(fabric, cfg, params, saved_opt_state=None):
@@ -230,33 +236,11 @@ def dreamer_family_loop(
             memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
         )
     # device-resident pixel mirror: sampled pixel sequences are gathered on
-    # device instead of shipped per window (buffers.DeviceMirror).  Budget
-    # check against the known obs shapes; silently stays off when the ring
-    # would not fit (or for the EpisodeBuffer layout, which has no ring).
-    mirror_on = (
-        bool(cfg.buffer.get("device_mirror", False))
-        and bool(cnn_keys)
-        and isinstance(rb, EnvIndependentReplayBuffer)
+    # device instead of shipped per window (buffers.DeviceMirror); off for
+    # the EpisodeBuffer layout, which has no ring
+    mirror_on = isinstance(rb, EnvIndependentReplayBuffer) and maybe_attach_mirror(
+        rb, cfg, fabric.accelerator, obs_space, cnn_keys
     )
-    if mirror_on:
-        ring_bytes = sum(
-            rb._buffer_size
-            * num_envs
-            * int(np.prod(obs_space[k].shape))
-            * np.dtype(obs_space[k].dtype).itemsize
-            for k in cnn_keys
-        )
-        budget = float(os.environ.get("SHEEPRL_MIRROR_BUDGET_BYTES", 6 * 2**30))
-        if ring_bytes <= budget:
-            rb.attach_mirror(cnn_keys)
-        else:
-            mirror_on = False
-            print(
-                f"[sheeprl_tpu] buffer.device_mirror disabled: pixel ring needs "
-                f"{ring_bytes / 2**30:.1f} GiB > budget {budget / 2**30:.1f} GiB "
-                "(set SHEEPRL_MIRROR_BUDGET_BYTES to raise)",
-                flush=True,
-            )
     # a checkpoint only contains "rb" if it was saved with buffer.checkpoint
     # (or injected explicitly, e.g. P2E finetuning's load_from_exploration) —
     # so presence alone decides
@@ -432,6 +416,11 @@ def dreamer_family_loop(
                         bytes_per_update = probe_bytes_per_update(
                             rb, batch_size, sequence_length=seq_len
                         )
+                    # ONE player sync per ratio window, hoisted OUT of the
+                    # chunk loop: a per-chunk refresh would pull the full
+                    # player params D2H once per chunk (~6 s per pull over
+                    # the tunnel x 257 burst chunks stalled the r5 capture)
+                    player_params = psync.before_dispatch(player_params)
                     for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
                         # with the device mirror, pixel keys never cross the
                         # host->device link: the host samples only the small
@@ -471,16 +460,12 @@ def dreamer_family_loop(
                         blocks["terminated"] = jnp.asarray(np.asarray(sample["terminated"], np.float32)[..., 0])
                         blocks["is_first"] = jnp.asarray(np.asarray(sample["is_first"], np.float32)[..., 0])
                         blocks = fabric.shard_batch(blocks, axis=2)
-                        # deferred sync AFTER the host-side sample/ship so that
-                        # work overlaps the tail of the previous window's device
-                        # compute (before_dispatch blocks on it — see PlayerSync)
-                        player_params = psync.before_dispatch(player_params)
                         key, tk = jax.random.split(key)
                         params, opt_state, last_metrics = train_phase(
                             params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
                         )
                         grad_step_counter += u
-                        player_params = psync.after_dispatch(params, player_params)
+                    player_params = psync.after_dispatch(params, player_params)
 
         # ---------------- logging ---------------------------------------------
         if cfg.metric.log_level > 0 and (

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.utils.utils import merge_framestack
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",
@@ -78,8 +80,7 @@ def prepare_obs(
     for k in cnn_keys:
         x = np.asarray(obs[k])
         if x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
-            b, s, h, w, c = x.shape
-            x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
+            x = merge_framestack(x)
         out[k] = jnp.asarray(x, jnp.float32) / 255.0 - 0.5
     for k in mlp_keys:
         out[k] = jnp.asarray(np.asarray(obs[k], np.float32).reshape(np.asarray(obs[k]).shape[0], -1))
@@ -120,17 +121,6 @@ def test(
     if logger is not None:
         logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
     return cum_reward
-
-
-def merge_framestack(x, xp=np):
-    """``(..., S, H, W, C)`` framestacked pixels -> ``(..., H, W, S*C)``.
-
-    One source of truth for the stack-to-channels layout every pixel train
-    path uses (host-shipped blocks pass ``xp=np``; device-mirror gathers
-    pass ``xp=jnp`` so the permute runs on device)."""
-    s = x.shape
-    x = xp.moveaxis(x, -4, -2)  # (..., H, W, S, C)
-    return x.reshape(*s[:-4], s[-3], s[-2], s[-4] * s[-1])
 
 
 def normalize_obs_block(data, cnn_keys, obs_keys, offset: float = 0.5):

@@ -9,6 +9,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from sheeprl_tpu.utils.utils import merge_framestack
+
 AGGREGATOR_KEYS = {
     "Rewards/rew_avg",
     "Game/ep_len_avg",
@@ -49,11 +51,9 @@ def obs_to_np(x: np.ndarray, is_image: bool, rollout: bool = False) -> np.ndarra
     if is_image:
         if rollout:
             if x.ndim == 6:  # (T, B, S, H, W, C) frame stack → channels
-                t, b, s, h, w, c = x.shape
-                x = np.transpose(x, (0, 1, 3, 4, 2, 5)).reshape(t, b, h, w, s * c)
+                x = merge_framestack(x)
         elif x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
-            b, s, h, w, c = x.shape
-            x = np.transpose(x, (0, 2, 3, 1, 4)).reshape(b, h, w, s * c)
+            x = merge_framestack(x)
         return np.asarray(x, np.float32) / 255.0
     return np.asarray(x, np.float32)
 

@@ -28,9 +28,9 @@ import optax
 
 from sheeprl_tpu.algos.sac.agent import ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
-from sheeprl_tpu.algos.dreamer_v3.utils import merge_framestack, normalize_obs_block
+from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_block
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
-from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.buffers import ReplayBuffer, maybe_attach_mirror
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -38,7 +38,15 @@ from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
 from sheeprl_tpu.utils.optim import build_optimizer
 from sheeprl_tpu.utils.registry import register_algorithm
 from sheeprl_tpu.utils.timer import timer
-from sheeprl_tpu.utils.utils import Ratio, probe_bytes_per_update, save_configs, TrainWindow, window_chunks, window_scan
+from sheeprl_tpu.utils.utils import (
+    Ratio,
+    TrainWindow,
+    merge_framestack,
+    probe_bytes_per_update,
+    save_configs,
+    window_chunks,
+    window_scan,
+)
 
 
 def _prep(obs: Dict[str, np.ndarray], cnn_keys, mlp_keys) -> Dict[str, jax.Array]:
@@ -298,6 +306,20 @@ def main(fabric: Any, cfg: Any) -> None:
         memmap=cfg.buffer.memmap,
         memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
     )
+    # device-resident pixel mirror (data/buffers.py DeviceMirror): SAC-AE
+    # stores next_<k> rows, so both are mirrored; ~2x the ring bytes
+    mirror_pixel_keys = tuple(
+        src for k in cnn_keys for src in (k, f"next_{k}")
+    )
+    mirror_on = maybe_attach_mirror(
+        rb,
+        cfg,
+        fabric.accelerator,
+        obs_space,
+        cnn_keys,
+        mirror_keys=mirror_pixel_keys,
+        copies_per_key=2,
+    )
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
@@ -363,14 +385,31 @@ def main(fabric: Any, cfg: Any) -> None:
                     # exceed HBM
                     if bytes_per_update is None:
                         bytes_per_update = probe_bytes_per_update(rb, batch_size)
+                    # one player sync per ratio window, not per chunk (a
+                    # per-chunk refresh pulls full player params D2H each
+                    # time — see the dreamer loop's note)
+                    player_params = psync.before_dispatch(player_params)
                     for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
-                        sample = rb.sample(batch_size, n_samples=u)
+                        sample_keys = None
+                        if mirror_on:
+                            sample_keys = tuple(
+                                src
+                                for k in mlp_keys
+                                for src in (k, f"next_{k}")
+                            ) + ("actions", "rewards", "terminated")
+                        sample = rb.sample(batch_size, n_samples=u, keys=sample_keys)
                         batches: Dict[str, jax.Array] = {
                             "actions": jnp.asarray(sample["actions"]),
                             "rewards": jnp.asarray(sample["rewards"][..., 0]),
                             "terminated": jnp.asarray(sample["terminated"][..., 0]),
                         }
-                        for k in cnn_keys:
+                        for src in mirror_pixel_keys if mirror_on else ():
+                            t_idx, e_idx = rb.last_sample_indices
+                            x = rb.mirror.gather(src, t_idx, e_idx)
+                            if x.ndim >= 6:  # (U, B[, N], S, H, W, C) framestack
+                                x = merge_framestack(x, jnp)
+                            batches[src] = x
+                        for k in cnn_keys if not mirror_on else ():
                             for src in (k, f"next_{k}"):
                                 x = np.asarray(sample[src])
                                 if x.ndim == 7:
@@ -381,15 +420,12 @@ def main(fabric: Any, cfg: Any) -> None:
                                 x = np.asarray(sample[src], np.float32)
                                 batches[src] = jnp.asarray(x.reshape(*x.shape[:2], -1))
                         batches = fabric.shard_batch(batches, axis=1)
-                        # deferred sync AFTER the host-side sample/ship so that work
-                        # overlaps the tail of the previous window's device compute
-                        player_params = psync.before_dispatch(player_params)
                         key, tk = jax.random.split(key)
                         params, opt_state, last_losses = train_phase(
                             params, opt_state, batches, tk, jnp.int32(grad_step_counter)
                         )
                         grad_step_counter += u
-                        player_params = psync.after_dispatch(params, player_params)
+                    player_params = psync.after_dispatch(params, player_params)
 
         if cfg.metric.log_level > 0 and (
             policy_step - last_log >= cfg.metric.log_every or update == total_iters or cfg.dry_run

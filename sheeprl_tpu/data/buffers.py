@@ -70,6 +70,14 @@ class DeviceMirror:
     this is that capability rebuilt for JAX: jitted donated scatter writes,
     jitted fancy-index gathers, ring positions computed on host so the
     mirror layout is bit-identical to the host ring's.
+
+    Multi-chip plan: under a data-parallel mesh each process mirrors only
+    its OWN env streams (per-rank buffers already split that way), so the
+    ring shards naturally across hosts; within one host's chips the gather
+    output is re-laid by ``fabric.shard_batch`` (a no-op on one device).
+    Sharding the ring itself over the mesh ``data`` axis — so each chip
+    holds 1/N of the slots and gathers ride ICI — is the v2 design for
+    single-host multi-chip; the host path stays the fallback everywhere.
     """
 
     def __init__(self, capacity: int, n_envs: int):
@@ -129,6 +137,50 @@ class DeviceMirror:
         return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in self._arrays.values())
 
 
+def maybe_attach_mirror(
+    rb: Any,
+    cfg: Any,
+    fabric_accelerator: str,
+    obs_space: Any,
+    cnn_keys: Sequence[str],
+    mirror_keys: Optional[Sequence[str]] = None,
+    copies_per_key: int = 1,
+) -> bool:
+    """One policy for every algo's ``buffer.device_mirror`` handling:
+    resolve ``auto`` (on iff training on an accelerator), estimate the ring
+    bytes from the observation space (× ``copies_per_key`` for layouts that
+    also store ``next_<k>`` rows), enforce ``SHEEPRL_MIRROR_BUDGET_BYTES``
+    (default 6 GiB) with a printed graceful fallback, and attach.
+    Returns whether the mirror is active."""
+    mirror_cfg = cfg.buffer.get("device_mirror", "auto")
+    if isinstance(mirror_cfg, str) and mirror_cfg.lower() == "auto":
+        # on CPU the "mirror" is a pure host-RAM duplicate: only worth it
+        # when the train device is a real accelerator
+        mirror_cfg = fabric_accelerator != "cpu"
+    if not (bool(mirror_cfg) and cnn_keys and hasattr(rb, "attach_mirror")):
+        return False
+    capacity = rb._buffer_size
+    ring_bytes = sum(
+        capacity
+        * rb.n_envs
+        * int(np.prod(obs_space[k].shape))
+        * np.dtype(obs_space[k].dtype).itemsize
+        * copies_per_key
+        for k in cnn_keys
+    )
+    budget = float(os.environ.get("SHEEPRL_MIRROR_BUDGET_BYTES", 6 * 2**30))
+    if ring_bytes > budget:
+        print(
+            f"[sheeprl_tpu] buffer.device_mirror disabled: pixel ring needs "
+            f"{ring_bytes / 2**30:.1f} GiB > budget {budget / 2**30:.1f} GiB "
+            "(set SHEEPRL_MIRROR_BUDGET_BYTES to raise)",
+            flush=True,
+        )
+        return False
+    rb.attach_mirror(tuple(mirror_keys) if mirror_keys is not None else tuple(cnn_keys))
+    return True
+
+
 class ReplayBuffer:
     """Uniform-sampling FIFO ring buffer over ``Dict[str, (size, n_envs, *)]``.
 
@@ -162,6 +214,39 @@ class ReplayBuffer:
         self._obs_keys = tuple(obs_keys)
         self._pos = 0
         self._full = False
+        self._mirror: Optional[DeviceMirror] = None
+        self._mirror_keys: Tuple[str, ...] = ()
+        # set by sample() when a mirror is attached: (U, B) ring slots +
+        # (U, B) env columns of the drawn transitions
+        self.last_sample_indices: Optional[Tuple[np.ndarray, np.ndarray]] = None
+
+    # -- device mirror -----------------------------------------------------
+    @property
+    def mirror(self) -> Optional[DeviceMirror]:
+        return self._mirror
+
+    def attach_mirror(self, keys: Sequence[str]) -> DeviceMirror:
+        """Mirror ``keys`` on the default device (see :class:`DeviceMirror`).
+
+        For next-observation training use buffers that STORE ``next_<k>``
+        rows (the SAC-AE layout) and mirror those keys too —
+        ``sample_next_obs`` derivation is not index-tracked.
+        """
+        self._mirror = DeviceMirror(self._buffer_size, self._n_envs)
+        self._mirror_keys = tuple(keys)
+        self._sync_mirror()
+        return self._mirror
+
+    def _sync_mirror(self) -> None:
+        filled = self._buffer_size if self._full else self._pos
+        if filled == 0:
+            return
+        idx = np.arange(filled)
+        for k in self._mirror_keys:
+            if k in self._buf:
+                self._mirror.write(
+                    k, np.asarray(self._buf[k])[idx], idx[:, None], list(range(self._n_envs))
+                )
 
     # -- properties -------------------------------------------------------
     @property
@@ -229,6 +314,10 @@ class ReplayBuffer:
         idx = (self._pos + np.arange(steps)) % self._buffer_size
         for k, v in data.items():
             self._buf[k][idx[:, None], env_sel[None, :]] = v
+        if self._mirror is not None:
+            for k in self._mirror_keys:
+                if k in data:
+                    self._mirror.write(k, np.asarray(data[k]), idx[:, None], list(env_sel))
         if self._pos + steps >= self._buffer_size:
             self._full = True
         self._pos = int((self._pos + steps) % self._buffer_size)
@@ -253,13 +342,17 @@ class ReplayBuffer:
         batch_size: int,
         sample_next_obs: bool = False,
         n_samples: int = 1,
+        keys: Optional[Sequence[str]] = None,
         **kwargs: Any,
     ) -> Arrays:
         """Uniformly sample ``n_samples`` × ``batch_size`` transitions.
 
         Returns ``(n_samples, batch_size, *)`` arrays.  When
         ``sample_next_obs`` is set, adds ``next_<key>`` entries for every
-        observation key by reading the successor step.
+        observation key by reading the successor step.  ``keys`` restricts
+        the gathered output (the drawn indices are unchanged — a
+        DeviceMirror gathers the excluded keys on device from
+        ``last_sample_indices``).
         """
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError("batch_size and n_samples must be positive")
@@ -271,19 +364,31 @@ class ReplayBuffer:
         total = batch_size * n_samples
         step_idx = valid[np.random.randint(0, valid.size, size=total)]
         env_idx = np.random.randint(0, self._n_envs, size=total)
-        batch = self._gather(step_idx, env_idx, sample_next_obs)
+        self.last_sample_indices = (
+            step_idx.reshape(n_samples, batch_size),
+            env_idx.reshape(n_samples, batch_size),
+        )
+        batch = self._gather(step_idx, env_idx, sample_next_obs, keys=keys)
         return {k: v.reshape(n_samples, batch_size, *v.shape[1:]) for k, v in batch.items()}
 
-    def _gather(self, step_idx: np.ndarray, env_idx: np.ndarray, sample_next_obs: bool) -> Arrays:
+    def _gather(
+        self,
+        step_idx: np.ndarray,
+        env_idx: np.ndarray,
+        sample_next_obs: bool,
+        keys: Optional[Sequence[str]] = None,
+    ) -> Arrays:
         out: Arrays = {}
         for k, v in self._buf.items():
+            if keys is not None and k not in keys:
+                continue
             arr = np.asarray(v)
             out[k] = arr[step_idx, env_idx]
         if sample_next_obs:
             next_idx = (step_idx + 1) % self._buffer_size
             obs_keys = self._obs_keys or tuple(k for k in self._buf if k.startswith("obs") or k == "observations")
             for k in obs_keys:
-                if k in self._buf:
+                if k in self._buf and (keys is None or k in keys):
                     out[f"next_{k}"] = np.asarray(self._buf[k])[next_idx, env_idx]
         return out
 
@@ -327,6 +432,8 @@ class ReplayBuffer:
         self._buf = dict(state["buffer"])
         self._pos = int(state["pos"])
         self._full = bool(state["full"])
+        if self._mirror is not None:
+            self._sync_mirror()  # mirror is derived state: rebuild on resume
         return self
 
 

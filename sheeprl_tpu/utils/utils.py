@@ -210,6 +210,18 @@ def window_scan(body, carry, xs, unroll_limit: int = 16, unroll: bool = True):
     return carry, stacked
 
 
+def merge_framestack(x, xp=np):
+    """``(..., S, H, W, C)`` framestacked pixels -> ``(..., H, W, S*C)``.
+
+    One source of truth for the stack-to-channels layout every pixel path
+    uses — train blocks, player/rollout prep, device-mirror gathers
+    (``xp=jnp`` runs the permute on device).  Arbitrary leading batch dims.
+    """
+    s = x.shape
+    x = xp.moveaxis(x, -4, -2)  # (..., H, W, S, C)
+    return x.reshape(*s[:-4], s[-3], s[-2], s[-4] * s[-1])
+
+
 def probe_bytes_per_update(rb, batch_size: int, **sample_kwargs) -> float:
     """Host-side byte cost of ONE update's sampled batch (for window_chunks).
 
@@ -239,12 +251,25 @@ def window_chunks(n_updates: int, bytes_per_update: float, budget_bytes: Optiona
     below the budget and stay single-dispatch.  Budget default 1 GiB
     (override ``SHEEPRL_MAX_WINDOW_BYTES``) — the padded-layout worst case
     observed is 2x raw, leaving ample HBM for params/activations.
+
+    Chunk sizes are powers of two (largest fitting the budget, greedily
+    decomposing the remainder) — every distinct chunk length compiles its
+    own train-phase executable, and a remote TPU compile costs minutes, so
+    a burst must reuse a handful of shapes rather than mint arbitrary ones
+    (and the small tail chunks coincide with the steady-state window sizes,
+    which are also tiny powers of two).
     """
     if budget_bytes is None:
         budget_bytes = float(os.environ.get("SHEEPRL_MAX_WINDOW_BYTES", 2**30))
     max_u = max(1, int(budget_bytes // max(bytes_per_update, 1.0)))
-    full, rem = divmod(int(n_updates), max_u)
-    return [max_u] * full + ([rem] if rem else [])
+    cap = 1 << (max_u.bit_length() - 1)  # largest power of two <= max_u
+    chunks = []
+    remaining = int(n_updates)
+    while remaining > 0:
+        step = min(cap, 1 << (remaining.bit_length() - 1))
+        chunks.append(step)
+        remaining -= step
+    return chunks
 
 
 def should_unroll_updates(cnn_keys, n_bodies: int, limit: int = 32) -> bool:

@@ -10,22 +10,19 @@ sharding / collective code paths run everywhere.
 import os
 
 # Must happen before any jax import anywhere in the test session.
-# SHEEPRL_TEST_PLATFORM=tpu opts OUT of the CPU pin so the regression
-# goldens can run against the real chip (second-platform drift validation,
-# DRIFT.md); everything else assumes the 8-device virtual CPU mesh.
-_TEST_PLATFORM = os.environ.get("SHEEPRL_TEST_PLATFORM", "cpu")
-if _TEST_PLATFORM == "cpu":
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    _flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in _flags:
-        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# (On-chip golden validation does NOT go through pytest — COMMON pins
+# fabric.accelerator=cpu — use `benchmarks/golden_drift.py --tpu`, which
+# runs the same recipes against the real chip and writes DRIFT.md.)
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
-if _TEST_PLATFORM == "cpu":
-    # The axon TPU plugin (sitecustomize) forces its own platform regardless
-    # of JAX_PLATFORMS; the config update below wins.
-    jax.config.update("jax_platforms", "cpu")
+# The axon TPU plugin (sitecustomize) forces its own platform regardless of
+# JAX_PLATFORMS; the config update below wins.
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

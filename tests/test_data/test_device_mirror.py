@@ -120,3 +120,87 @@ def test_dreamer_e2e_mirror_equivalence(tmp_path):
         )
         results[mirror] = _last_metrics(logs)
     assert results["False"] and results["False"] == results["True"]
+
+
+# ---- base ReplayBuffer mirror (SAC-AE layout: stored next_<k> rows) ----
+
+
+def _uniform_step(t, n_envs=2, hw=8):
+    rgb = np.zeros((1, n_envs, hw, hw, 3), np.uint8)
+    nxt = np.zeros((1, n_envs, hw, hw, 3), np.uint8)
+    for e in range(n_envs):
+        rgb[0, e] = (t * 5 + e * 17) % 256
+        nxt[0, e] = (t * 5 + e * 17 + 1) % 256
+    return {
+        "rgb": rgb,
+        "next_rgb": nxt,
+        "rewards": np.full((1, n_envs), float(t), np.float32),
+    }
+
+
+def _assert_uniform_mirror_matches(rb, batch_size=4, n_samples=3):
+    state = np.random.get_state()
+    host = rb.sample(batch_size, n_samples=n_samples)
+    np.random.set_state(state)
+    rb.sample(batch_size, n_samples=n_samples, keys=("rewards",))
+    t_idx, e_idx = rb.last_sample_indices
+    for k in ("rgb", "next_rgb"):
+        got = np.asarray(rb.mirror.gather(k, t_idx, e_idx))
+        np.testing.assert_array_equal(got, host[k])
+
+
+def test_uniform_mirror_matches_host():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    np.random.seed(11)
+    rb = ReplayBuffer(16, n_envs=2)
+    rb.attach_mirror(["rgb", "next_rgb"])
+    for t in range(10):
+        rb.add(_uniform_step(t))
+    _assert_uniform_mirror_matches(rb)
+
+
+def test_uniform_mirror_wraparound_and_prefill_sync():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    np.random.seed(12)
+    rb = ReplayBuffer(8, n_envs=2)
+    for t in range(11):  # wrap before the mirror exists
+        rb.add(_uniform_step(t))
+    rb.attach_mirror(["rgb", "next_rgb"])
+    for t in range(11, 30):  # and after
+        rb.add(_uniform_step(t))
+    _assert_uniform_mirror_matches(rb)
+
+
+def test_uniform_mirror_resume_resync():
+    from sheeprl_tpu.data.buffers import ReplayBuffer
+
+    np.random.seed(13)
+    rb = ReplayBuffer(8, n_envs=2)
+    rb.attach_mirror(["rgb", "next_rgb"])
+    for t in range(6):
+        rb.add(_uniform_step(t))
+    rb2 = ReplayBuffer(8, n_envs=2)
+    rb2.attach_mirror(["rgb", "next_rgb"])
+    rb2.load_state_dict(rb.state_dict())
+    _assert_uniform_mirror_matches(rb2)
+
+
+@pytest.mark.slow
+def test_sac_ae_e2e_mirror_equivalence(tmp_path):
+    """SAC-AE dry run with the mirror ON equals the host-ship path
+    bit-for-bit (same draws, same bytes)."""
+    from tests.test_regression.test_golden import COMMON, FAMILIES, _last_metrics
+    from sheeprl_tpu.cli import run
+
+    results = {}
+    for mirror in ("False", "True"):
+        logs = tmp_path / f"mirror_{mirror}"
+        run(
+            COMMON
+            + FAMILIES["sac_ae"]
+            + [f"buffer.device_mirror={mirror}", f"log_dir={logs}"]
+        )
+        results[mirror] = _last_metrics(logs)
+    assert results["False"] and results["False"] == results["True"]

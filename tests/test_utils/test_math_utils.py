@@ -135,11 +135,13 @@ class TestWindowChunks:
     def test_burst_split_and_total_preserved(self):
         from sheeprl_tpu.utils.utils import window_chunks
 
-        # DV3-S walker-walk shape: ~12.6 MB/update, 1 GiB budget -> 85/chunk
-        chunks = window_chunks(1024, 12.6e6)
-        assert sum(chunks) == 1024
+        # DV3-S walker-walk shape: ~12.6 MB/update, 1 GiB budget -> <=85/chunk,
+        # power-of-two sizes (compile reuse: each distinct U compiles once)
+        chunks = window_chunks(1026, 12.6e6)
+        assert sum(chunks) == 1026
         assert max(chunks) * 12.6e6 <= 2**30
-        assert len(set(chunks[:-1])) <= 1  # uniform full chunks, one remainder
+        assert all(c & (c - 1) == 0 for c in chunks)  # powers of two
+        assert len(set(chunks)) <= 3  # few distinct compiled shapes
 
     def test_budget_env_override(self, monkeypatch):
         from sheeprl_tpu.utils.utils import window_chunks
