@@ -193,7 +193,30 @@ class Fabric:
         device, ``device_put`` would be a no-op alias — and the training
         step donates its params input, which would invalidate the player's
         copy mid-rollout.  ``.copy()`` breaks the alias.
+
+        Cross-platform trees (the host-player param pull) take the PACKED
+        path: per-leaf transfers cost one link round-trip each (~65 ms over
+        the axon tunnel — a ~40-leaf player tree paid ~2.6 s per refresh),
+        so same-dtype leaves are flattened into one device-side buffer per
+        dtype, moved in one transfer, and split on the target.
         """
+        leaves, treedef = jax.tree.flatten(tree)
+        if all(isinstance(x, jax.Array) and x.is_fully_addressable for x in leaves):
+            # replicated multi-device params (any real mesh) carry the full
+            # value in every shard — pack from the process-local one
+            single = [
+                x if len(x.devices()) == 1
+                else (x.addressable_shards[0].data if x.sharding.is_fully_replicated else None)
+                for x in leaves
+            ]
+            src = {next(iter(x.devices())) for x in single if x is not None}
+            if (
+                len(leaves) > 1
+                and all(x is not None for x in single)
+                and len(src) == 1
+                and next(iter(src)).platform != device.platform
+            ):
+                return treedef.unflatten(_packed_copy(single, device))
 
         def put(x: Any) -> Any:
             if isinstance(x, jax.Array) and not x.is_fully_addressable:
@@ -523,6 +546,33 @@ class PlayerSync:
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
         self._windows = int(state.get("windows", 0))
+
+
+def _packed_copy(leaves: Any, device: Any) -> Any:
+    """Move a flat list of same-device arrays to ``device`` in ONE transfer
+    per dtype: flatten+concatenate on the SOURCE device (one fused program),
+    ship the packed buffer, split+reshape on the target.  Values are
+    bit-identical to per-leaf ``device_put`` (no casts — leaves group by
+    exact dtype, and weak-typed leaves go per-leaf: concatenate would
+    strip weak_type and change downstream promotion).
+    See ``Fabric.copy_to`` for why this exists."""
+    by_dtype: Dict[Any, list] = {}
+    for i, x in enumerate(leaves):
+        by_dtype.setdefault((x.dtype, bool(getattr(x, "weak_type", False))), []).append(i)
+    out: list = [None] * len(leaves)
+    for (dtype, weak), idxs in by_dtype.items():
+        if len(idxs) == 1 or weak:
+            for i in idxs:
+                out[i] = jax.device_put(leaves[i], device)
+            continue
+        packed = jnp.concatenate([jnp.ravel(leaves[i]) for i in idxs])
+        packed = jax.device_put(packed, device)
+        offset = 0
+        for i in idxs:
+            n = leaves[i].size
+            out[i] = packed[offset : offset + n].reshape(leaves[i].shape)
+            offset += n
+    return out
 
 
 def _pickle_to_u8(obj: Any) -> np.ndarray:

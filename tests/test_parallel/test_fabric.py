@@ -217,3 +217,40 @@ def test_compilation_cache_dir_config(tmp_path):
     finally:
         jax.config.update("jax_persistent_cache_min_compile_time_secs", orig_min)
         jax.config.update("jax_compilation_cache_dir", orig_dir)
+
+
+def test_packed_copy_bit_identical():
+    """_packed_copy (the single-transfer cross-platform player pull) must
+    return the same values/shapes/dtypes as per-leaf device_put."""
+    import numpy as np
+    from sheeprl_tpu.parallel.fabric import _packed_copy
+
+    rng = np.random.default_rng(0)
+    leaves = [
+        jnp.asarray(rng.normal(size=(3, 4)).astype(np.float32)),
+        jnp.asarray(rng.normal(size=(7,)).astype(np.float32)),
+        jnp.asarray(rng.integers(0, 255, (2, 2, 3)).astype(np.uint8)),
+        jnp.asarray(rng.normal(size=()).astype(np.float32)),
+        jnp.asarray(np.zeros((0, 5), np.float32)),  # empty leaf
+    ]
+    dev = jax.devices()[0]
+    got = _packed_copy(leaves, dev)
+    assert len(got) == len(leaves)
+    for g, want in zip(got, leaves):
+        assert g.dtype == want.dtype and g.shape == want.shape
+        np.testing.assert_array_equal(np.asarray(g), np.asarray(want))
+        assert set(g.devices()) == {dev}
+
+
+def test_packed_copy_preserves_weak_type():
+    from sheeprl_tpu.parallel.fabric import _packed_copy
+
+    leaves = [
+        jnp.asarray([1.0, 2.0]),          # strong f32
+        jnp.asarray([3.0]),               # strong f32
+        jnp.array(0.5),                   # WEAK f32 scalar (log-alpha style)
+    ]
+    assert leaves[2].weak_type
+    got = _packed_copy(leaves, jax.devices()[0])
+    assert got[2].weak_type, "packed copy must not strip weak_type"
+    assert not got[0].weak_type
