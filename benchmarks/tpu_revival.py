@@ -71,6 +71,23 @@ STAGES = [
         "dreamer_v3_wall_on_chip",
         [sys.executable, "bench.py"],
         {"BENCH_TARGET": "dreamer_v3_wall", "BENCH_ON_ACCEL": "1",
+         "BENCH_ARGS": "env=dummy env.id=discrete_dummy",  # no ALE in image
+         "BENCH_TIMEOUT": "3600"},
+        3700,
+    ),
+    (
+        "dreamer_v2_wall_on_chip",
+        [sys.executable, "bench.py"],
+        {"BENCH_TARGET": "dreamer_v2_wall", "BENCH_ON_ACCEL": "1",
+         "BENCH_ARGS": "env=dummy env.id=discrete_dummy",
+         "BENCH_TIMEOUT": "3600"},
+        3700,
+    ),
+    (
+        "dreamer_v1_wall_on_chip",
+        [sys.executable, "bench.py"],
+        {"BENCH_TARGET": "dreamer_v1_wall", "BENCH_ON_ACCEL": "1",
+         "BENCH_ARGS": "env=dummy env.id=discrete_dummy",
          "BENCH_TIMEOUT": "3600"},
         3700,
     ),
