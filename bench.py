@@ -68,6 +68,26 @@ def bench_dreamer_v3() -> dict:
     B = int(os.environ.get("BENCH_B", 16))
     U = int(os.environ.get("BENCH_U", 4))
     rng = np.random.default_rng(0)
+    # TPU tiled layout pads the pixel block ~2x (measured: (1024,64,16,64,64,3)
+    # u8 allocates 25.8 GiB for 12.9 GiB raw) — refuse shapes whose PER-DEVICE
+    # share (the block shards over the mesh) cannot fit HBM next to params,
+    # instead of hanging in a doomed compile.  Emitted as a JSON result, not
+    # an exception: a raise would make the watchdog misread a deliberate
+    # refusal as an accelerator outage and grind the same shape on CPU.
+    dev = jax.devices()[0]
+    if dev.platform == "tpu":
+        hbm = (dev.memory_stats() or {}).get("bytes_limit", 16 * 2**30)
+        per_dev = U * L * B * 64 * 64 * 3 * 2.2 / max(len(jax.devices()), 1)
+        if per_dev > 0.9 * hbm:
+            return {
+                "metric": (
+                    f"bench_refused: (U={U}, L={L}, B={B}) needs ~{per_dev / 2**30:.1f} GiB "
+                    f"padded per device vs {hbm / 2**30:.0f} GiB HBM; reduce BENCH_U/B/L"
+                ),
+                "value": 0,
+                "unit": "",
+                "vs_baseline": None,
+            }
     block = {
         "rgb": jnp.asarray(rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(np.uint8)),
         "actions": jnp.asarray(rng.integers(0, 2, (U, L, B, 4)).astype(np.float32)),
@@ -319,7 +339,9 @@ def _watchdog_main() -> None:
         env.setdefault("BENCH_U", "2")
     result = run_child(env, timeout_s)
     if result is not None:
-        result["metric"] += " [accelerator unreachable: CPU fallback]"
+        result["metric"] += (
+            " [accelerator unreachable: CPU fallback; real-chip captures in BENCH_TPU.md]"
+        )
     emit(result)
 
 
