@@ -204,3 +204,53 @@ def test_sac_ae_e2e_mirror_equivalence(tmp_path):
         )
         results[mirror] = _last_metrics(logs)
     assert results["False"] and results["False"] == results["True"]
+
+
+# ---- maybe_attach_mirror policy ----
+
+
+class _Cfg(dict):
+    __getattr__ = dict.__getitem__
+
+
+def _cfg(value):
+    return _Cfg(buffer=_Cfg({"device_mirror": value}))
+
+
+def _obs_space():
+    import gymnasium as gym
+
+    return gym.spaces.Dict({"rgb": gym.spaces.Box(0, 255, (8, 8, 3), np.uint8)})
+
+
+def test_maybe_attach_auto_resolution(monkeypatch):
+    from sheeprl_tpu.data.buffers import maybe_attach_mirror
+
+    monkeypatch.delenv("SHEEPRL_MIRROR_BUDGET_BYTES", raising=False)
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    # auto + cpu accelerator -> off
+    assert not maybe_attach_mirror(rb, _cfg("auto"), "cpu", _obs_space(), ("rgb",))
+    assert rb.mirror is None
+    # auto + tpu accelerator -> on
+    assert maybe_attach_mirror(rb, _cfg("auto"), "tpu", _obs_space(), ("rgb",))
+    assert rb.mirror is not None
+    # explicit False -> off even on tpu
+    rb2 = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    assert not maybe_attach_mirror(rb2, _cfg(False), "tpu", _obs_space(), ("rgb",))
+
+
+def test_maybe_attach_budget_refusal(monkeypatch, capsys):
+    from sheeprl_tpu.data.buffers import maybe_attach_mirror
+
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    monkeypatch.setenv("SHEEPRL_MIRROR_BUDGET_BYTES", "100")  # ring needs 3072 B
+    assert not maybe_attach_mirror(rb, _cfg(True), "tpu", _obs_space(), ("rgb",))
+    assert rb.mirror is None
+    assert "device_mirror disabled" in capsys.readouterr().out
+
+
+def test_maybe_attach_no_cnn_keys():
+    from sheeprl_tpu.data.buffers import maybe_attach_mirror
+
+    rb = EnvIndependentReplayBuffer(8, n_envs=2, buffer_cls=SequentialReplayBuffer)
+    assert not maybe_attach_mirror(rb, _cfg(True), "tpu", _obs_space(), ())
