@@ -14,9 +14,14 @@ TPU-native architecture:
   host samples a ``(U, L, B, *)`` block in one call (the reference's own
   bulk-sample pattern, dreamer_v3.py:664-671) and the device scans over U
   full updates (world model + actor + critic + EMA);
-* the environment player is a host-CPU latent-state policy refreshed once
-  per window — zero device round-trips during interaction;
-* images ship uint8 and normalize on device; batches shard over the mesh
+* the environment player is a latent-state policy on ``algo.player.device``
+  (host CPU by default — zero device round-trips during interaction —
+  or ``accelerator`` for thin links / big encoders), refreshed once per
+  ratio window via a packed single-transfer param pull;
+* pixel replay can live ON DEVICE (``buffer.device_mirror``): sampled
+  sequences are gathered from a mirrored uint8 ring at host-drawn ring
+  coordinates, so training never ships pixel blocks; otherwise images
+  ship uint8 and normalize on device; batches shard over the mesh
   ``data`` axis, params replicated (GSPMD gradient all-reduce), and the
   Moments quantile is computed on the global batch — which IS the
   reference's all-gathered Moments semantics (utils.py:56-63).
