@@ -100,13 +100,18 @@ def bench_dreamer_v3() -> dict:
     block = fabric.shard_batch(block, axis=2)
     key = jax.random.PRNGKey(0)
 
-    # AOT-compile once; the SAME executable serves cost_analysis (XLA's own
-    # FLOP count — no hand-derived model formula to drift), the warmup and
-    # the timed loop, so the heavy train-phase program is never compiled
-    # twice.  Fall back to the plain jit wrapper if AOT fails.
+    # AOT-compile once through the compile-once layer (make_train_phase now
+    # returns an AOTFunction); the SAME executable serves cost_analysis
+    # (XLA's own FLOP count — no hand-derived model formula to drift), the
+    # warmup and the timed loop, so the heavy train-phase program is never
+    # compiled twice.  Fall back to the plain jit wrapper if AOT fails.
+    # The compile-vs-steady split is reported as SEPARATE JSON fields
+    # (`first_call_s` / `steady_updates_per_s`) so the trajectory can tell a
+    # compile-time regression from a math-throughput one.
     flops_per_update = None
+    t_first = time.perf_counter()
     try:
-        compiled = train_phase.lower(params, opt_state, block, key, jnp.int32(0)).compile()
+        compiled = train_phase.compile_for(params, opt_state, block, key, jnp.int32(0))
         train_phase = compiled
         cost = compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
@@ -115,7 +120,8 @@ def bench_dreamer_v3() -> dict:
     except Exception:
         pass  # cost analysis is best-effort; the throughput number still stands
 
-    # warmup (compile happens here only on the AOT-fallback path).
+    # warmup = first dispatch (compile happens here only on the AOT-fallback
+    # path, so first_call_s covers compile + first execution either way).
     # device_sync, NOT block_until_ready: the latter resolves at dispatch on
     # the axon tunnel, which produced the phantom r5 first-capture numbers
     # (BENCH_TPU.md timing-validity note).
@@ -123,6 +129,7 @@ def bench_dreamer_v3() -> dict:
 
     params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(0))
     device_sync((params, metrics))
+    first_call_s = time.perf_counter() - t_first
 
     t0 = time.perf_counter()
     iters = int(os.environ.get("BENCH_ITERS", 10))
@@ -137,6 +144,9 @@ def bench_dreamer_v3() -> dict:
     comparable = size == "S" and B == 16 and L == 64
     dev = jax.devices()[0]
     platform = dev.platform
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+
+    n_exe, compile_s = COMPILE_MONITOR.totals()
     result = {
         "metric": (
             f"dreamer_v3_{size}_gradient_updates_per_s "
@@ -145,6 +155,13 @@ def bench_dreamer_v3() -> dict:
         "value": round(updates_per_s, 3),
         "unit": "updates/s",
         "vs_baseline": round(updates_per_s / BASELINE_DV3_UPDATES_PER_S, 3) if comparable else None,
+        # compile-time vs steady-state split (compile-once layer): first_call_s
+        # covers AOT lowering+compilation plus the first dispatch; the timed
+        # loop above starts only after it, so `value` is pure steady-state
+        "first_call_s": round(first_call_s, 3),
+        "steady_updates_per_s": round(updates_per_s, 3),
+        "compile_executables": n_exe,
+        "compile_time_s": round(compile_s, 3),
     }
     if flops_per_update is not None:
         result["flops_per_update"] = flops_per_update

@@ -17,6 +17,7 @@ Writes `tests/test_regression/DRIFT.md`.
 from __future__ import annotations
 
 import json
+import math
 import os
 import subprocess
 import sys
@@ -113,6 +114,12 @@ def _tpu_mode() -> int:
         for name, want in goldens[fam].items():
             have = results.get(fam, {}).get(name)
             if have is None:
+                continue
+            if not math.isfinite(have):
+                # NaN compares False against every threshold, so a diverged
+                # chip run used to sail through the gate — non-finite is an
+                # explicit failure, not a pass
+                failures.append(f"{fam}:{name} (non-finite: {have})")
                 continue
             delta = abs(have - want)
             atol = max(ATOL, ATOL_FOREIGN.get(f"{fam}:{name}", 0.0))

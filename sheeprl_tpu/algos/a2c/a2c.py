@@ -16,7 +16,6 @@ to the MXU.  Agent/encoder/player machinery is shared with PPO
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -94,7 +93,6 @@ def main(fabric: Any, cfg: Any) -> None:
     gamma = float(cfg.algo.gamma)
     gae_lambda = float(cfg.algo.gae_lambda)
 
-    @jax.jit
     def policy_step_fn(p, obs, k):
         # key advances INSIDE the jitted step: one dispatch per env step
         # instead of three (split + fold_in used to run as separate host
@@ -104,6 +102,14 @@ def main(fabric: Any, cfg: Any) -> None:
         actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k_sample, dist_type=dist_type)
         return actions, logprob, value[..., 0], k_next
 
+    # compile-once routing: AOT-compiled per abstract signature, counted by
+    # the recompile detector (parallel/compile.py)
+    policy_step_fn = fabric.compile(
+        policy_step_fn,
+        name=f"{cfg.algo.name}.policy_step",
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
+
     @jax.jit
     def values_fn(p, obs):
         _, value = agent.apply(p, obs)
@@ -111,7 +117,6 @@ def main(fabric: Any, cfg: Any) -> None:
 
     player_params = fabric.to_host(params)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, rollout, last_obs):
         """GAE + one full-batch gradient step, in one device program."""
         T, B = rollout["rewards"].shape
@@ -137,6 +142,13 @@ def main(fabric: Any, cfg: Any) -> None:
         updates, o_state = optimizer.update(grads, o_state, p)
         p = optax.apply_updates(p, updates)
         return p, o_state, (pg, vl, e)
+
+    train_phase = fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     rollout_steps = int(cfg.algo.rollout_steps)
     sharded_envs, _ = fabric.env_sharding_plan(num_envs, "A2C")

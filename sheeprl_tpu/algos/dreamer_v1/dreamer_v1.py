@@ -11,7 +11,6 @@ Uses the shared Dreamer family loop and module stack (see dreamer_v1/agent).
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
 import jax
@@ -233,7 +232,6 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return (p, o_state, counter + 1), metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
@@ -242,7 +240,12 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
-    return train_phase
+    return fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
 
 @register_algorithm()

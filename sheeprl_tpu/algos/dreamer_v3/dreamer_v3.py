@@ -30,7 +30,6 @@ TPU-native architecture:
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict, Tuple
 
 import jax
@@ -66,6 +65,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
     Ratio,
     merge_framestack,
+    mirror_hbm_bytes_per_update,
     probe_bytes_per_update,
     save_configs,
     window_chunks,
@@ -166,8 +166,7 @@ def dreamer_family_loop(
     use_action_masks = bool(cfg.algo.actor.get("action_masks", False))
     mask_keys = ("mask_action_type", "mask_craft_smelt", "mask_equip_place", "mask_destroy")
 
-    @partial(jax.jit, static_argnames=("greedy",))
-    def player_step(p, carry, obs, k, greedy=False):
+    def player_step_fn(p, carry, obs, k, greedy=False):
         """(h, z, prev_action) carry; returns new carry + env-space action +
         the advanced key (advancing it in-program saves two host dispatches
         per env step)."""
@@ -187,6 +186,15 @@ def dreamer_family_loop(
         else:
             action = actor.sample(head, k_act, greedy=greedy)
         return (h, z, action), action, k_next
+
+    # compile-once routing: the player executable is AOT-compiled per
+    # abstract signature and counted by the recompile detector
+    player_step = fabric.compile(
+        player_step_fn,
+        name=f"{cfg.algo.name}.player_step",
+        static_argnames=("greedy",),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     def init_player_carry(batch: int) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
         return (
@@ -289,9 +297,23 @@ def dreamer_family_loop(
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
     bytes_per_update = None  # probed at the first train window (window_chunks)
+    mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
     # per-rank player key stream, advanced inside player_step; the main
     # `key` stays rank-identical for train dispatches
     player_key = jax.device_put(jax.random.fold_in(key, rank), host)
+
+    # parallel compile warm-up: the player executable lowers+compiles in the
+    # pool while this thread steps random prefill actions (XLA compilation
+    # releases the GIL), so the first post-prefill policy step finds its
+    # executable already built instead of stalling the rollout
+    if bool(cfg.algo.get("compile_warmup", True)):
+        def _warm_player(first_obs=obs):
+            with jax.default_device(host):
+                warm_obs = prepare_obs(first_obs, cnn_keys, mlp_keys)
+                carry0 = tuple(jnp.asarray(c) for c in init_player_carry(num_envs))
+                player_step.warmup(player_params, carry0, warm_obs, player_key)
+
+        fabric.compile_pool.submit_fn(_warm_player)
 
     from sheeprl_tpu.utils.profiler import ProfilerGate
 
@@ -417,25 +439,41 @@ def dreamer_family_loop(
                     # (U, L, B, *) block can exceed the device byte budget —
                     # see utils.window_chunks; steady-state windows stay
                     # single-dispatch
+                    #
+                    # with the device mirror, pixel keys never cross the
+                    # host->device link: the host samples only the small
+                    # keys (and the ring coordinates), the device gathers
+                    # the pixel sequences from its mirrored ring
+                    sample_keys = (
+                        tuple(mlp_keys) + ("actions", "rewards", "terminated", "is_first")
+                        if mirror_on
+                        else None
+                    )
                     if bytes_per_update is None:
+                        # probe only the keys that actually SHIP: sizing the
+                        # H2D chunking against pixel bytes the mirror never
+                        # ships would shrink chunks ~100x for nothing.  The
+                        # on-device gathered pixel block still consumes HBM —
+                        # budgeted separately below (window_chunks caps both).
                         bytes_per_update = probe_bytes_per_update(
-                            rb, batch_size, sequence_length=seq_len
+                            rb, batch_size, sequence_length=seq_len, keys=sample_keys
                         )
+                        if mirror_on:
+                            mirror_hbm_bytes = mirror_hbm_bytes_per_update(
+                                obs_space, cnn_keys, batch_size, rows=seq_len
+                            )
+                        else:
+                            mirror_hbm_bytes = 0.0
                     # ONE player sync per ratio window, hoisted OUT of the
                     # chunk loop: a per-chunk refresh would pull the full
                     # player params D2H once per chunk (~6 s per pull over
                     # the tunnel x 257 burst chunks stalled the r5 capture)
                     player_params = psync.before_dispatch(player_params)
-                    for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
-                        # with the device mirror, pixel keys never cross the
-                        # host->device link: the host samples only the small
-                        # keys (and the ring coordinates), the device gathers
-                        # the pixel sequences from its mirrored ring
-                        sample_keys = (
-                            tuple(mlp_keys) + ("actions", "rewards", "terminated", "is_first")
-                            if mirror_on
-                            else None
-                        )
+                    for u in window_chunks(
+                        per_rank_gradient_steps,
+                        bytes_per_update,
+                        hbm_bytes_per_update=mirror_hbm_bytes,
+                    ):
                         sample = rb.sample(
                             batch_size,
                             n_samples=u,
@@ -776,7 +814,6 @@ def make_train_phase(
         )
         return (p, o_state, counter + 1), metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
@@ -784,4 +821,10 @@ def make_train_phase(
             single_update, (p, o_state, counter0), (blocks, keys), unroll=bool(cnn_keys)
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
-    return train_phase
+
+    return fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )

@@ -18,7 +18,6 @@ single-dispatch scanned train phase as the rest of the Dreamer family.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Dict
 
 import flax.linen as nn
@@ -429,7 +428,6 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return (p, o_state, counter + 1), metrics
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, blocks, k, counter0):
         U = blocks["rewards"].shape[0]
         keys = jax.random.split(k, U)
@@ -438,7 +436,12 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
-    return train_phase
+    return fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
 
 def build_p2e_optimizers(fabric, cfg, params, saved=None):

@@ -30,7 +30,6 @@ TPU-native architecture (not a port) — shaped by accelerator latency:
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -146,7 +145,6 @@ def main(fabric: Any, cfg: Any) -> None:
     # current weights)
     host = fabric.player_device(cfg)
 
-    @partial(jax.jit, static_argnames=("greedy",))
     def policy_step_fn(p, obs, k, greedy=False):
         # key advances INSIDE the jitted step — one host dispatch per env
         # step instead of three (split/fold_in as separate tiny programs)
@@ -154,6 +152,15 @@ def main(fabric: Any, cfg: Any) -> None:
         out, value = agent.apply(p, obs)
         actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k_sample, greedy=greedy, dist_type=dist_type)
         return actions, logprob, value[..., 0], k_next
+
+    # compile-once routing: AOT-compiled per abstract signature, counted by
+    # the recompile detector (parallel/compile.py)
+    policy_step_fn = fabric.compile(
+        policy_step_fn,
+        name=f"{cfg.algo.name}.policy_step",
+        static_argnames=("greedy",),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     @jax.jit
     def values_fn(p, obs):
@@ -182,11 +189,6 @@ def main(fabric: Any, cfg: Any) -> None:
         ent = entropy_loss(entropy, reduction)
         return pg + vf_coef * vl + ent_coef * ent, (pg, vl, ent)
 
-    @partial(
-        jax.jit,
-        donate_argnums=(0, 1),
-        static_argnames=("batch_size", "num_minibatches", "share_data", "n_shards"),
-    )
     def train_phase(
         p,
         o_state,
@@ -272,6 +274,14 @@ def main(fabric: Any, cfg: Any) -> None:
         )
         last_losses = jax.tree.map(lambda x: x[-1], losses)
         return p, o_state, last_losses
+
+    train_phase = fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        static_argnames=("batch_size", "num_minibatches", "share_data", "n_shards"),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     # ---------------- counters / schedules ----------------------------------
     # the train phase is a GLOBAL program: its batch covers all ranks

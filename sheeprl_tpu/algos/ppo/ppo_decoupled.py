@@ -26,7 +26,6 @@ still scales training exactly like adding trainer ranks in the reference.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -45,6 +44,7 @@ from sheeprl_tpu.algos.ppo.utils import (
     test,
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel.compile import compile_once
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -67,13 +67,20 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
     gae_lambda = float(cfg.algo.gae_lambda)
     update_epochs = int(cfg.algo.update_epochs)
 
-    @jax.jit
     def policy_step_fn(p, obs, k):
         # key advances INSIDE the jitted step (one host dispatch per env step)
         k_sample, k_next = jax.random.split(k)
         out, value = agent.apply(p, obs)
         actions, logprob, _ = sample_actions(out, actions_dim, is_continuous, k_sample, dist_type=dist_type)
         return actions, logprob, value[..., 0], k_next
+
+    # compile-once routing (no fabric in scope for this shared builder:
+    # use the module-level constructor directly)
+    policy_step_fn = compile_once(
+        policy_step_fn,
+        name=f"{cfg.algo.name}.policy_step",
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     @jax.jit
     def values_fn(p, obs):
@@ -91,7 +98,6 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
         ent = entropy_loss(entropy, reduction)
         return pg + vf_coef * vl + ent_coef * ent, (pg, vl, ent)
 
-    @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("batch_size", "num_minibatches"))
     def train_phase(p, o_state, rollout, last_obs, k, clip_coef, ent_coef, batch_size, num_minibatches):
         T, B = rollout["rewards"].shape
         flat_obs = {kk: rollout[kk].reshape((T * B,) + rollout[kk].shape[2:]) for kk in obs_keys}
@@ -144,6 +150,14 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
             unroll=unroll_updates,
         )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
+
+    train_phase = compile_once(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        static_argnames=("batch_size", "num_minibatches"),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     return policy_step_fn, values_fn, train_phase
 

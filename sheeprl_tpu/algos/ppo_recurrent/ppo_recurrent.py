@@ -18,7 +18,6 @@ TPU-native differences:
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import jax
@@ -136,7 +135,6 @@ def main(fabric: Any, cfg: Any) -> None:
     reduction = cfg.algo.loss_reduction
     update_epochs = int(cfg.algo.update_epochs)
 
-    @jax.jit
     def policy_step_fn(p, carry, obs, prev_actions, is_first, k):
         # key advances INSIDE the jitted step (one host dispatch per env step)
         k_sample, k_next = jax.random.split(k)
@@ -147,7 +145,14 @@ def main(fabric: Any, cfg: Any) -> None:
         actions, logprob = _sample(actor_out, actions_dim, is_continuous, k_sample)
         return carry, actions, logprob, value[..., 0], k_next
 
-    @partial(jax.jit, donate_argnums=(0, 1), static_argnames=("env_bs", "num_minibatches"))
+    # compile-once routing: AOT-compiled per abstract signature, counted by
+    # the recompile detector (parallel/compile.py)
+    policy_step_fn = fabric.compile(
+        policy_step_fn,
+        name=f"{cfg.algo.name}.policy_step",
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
+
     def train_phase(p, o_state, rollout, init_carry, last_values, k, ent_coef, env_bs, num_minibatches):
         """Forward scan + GAE + epochs of env-axis minibatch updates."""
         T, B = rollout["rewards"].shape
@@ -212,6 +217,14 @@ def main(fabric: Any, cfg: Any) -> None:
             epoch_body, (p, o_state), jax.random.split(k, update_epochs)
         )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
+
+    train_phase = fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        static_argnames=("env_bs", "num_minibatches"),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     # ---------------- counters ----------------------------------------------
     rollout_steps = int(cfg.algo.rollout_steps)

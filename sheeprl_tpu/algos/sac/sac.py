@@ -21,7 +21,6 @@ TPU-native structure:
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -34,6 +33,7 @@ from sheeprl_tpu.algos.sac.agent import build_agent, ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.parallel.compile import compile_once
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -64,13 +64,21 @@ def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha
     target_entropy = -float(act_dim)
     target_freq = int(cfg.algo.critic.target_network_frequency)
 
-    @partial(jax.jit, static_argnames=("greedy",))
     def act_fn(p, obs, k, greedy=False):
         # key advances INSIDE the jitted step (one host dispatch per env
         # step instead of three; callers thread the returned key)
         k_sample, k_next = jax.random.split(k)
         a, _ = sample_action(actor, p, obs, k_sample, greedy=greedy)
         return a, k_next
+
+    # compile-once routing (parallel/compile.py): AOT-compiled per abstract
+    # signature, counted by the recompile detector
+    act_fn = compile_once(
+        act_fn,
+        name=f"{cfg.algo.name}.act_fn",
+        static_argnames=("greedy",),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     def one_update(carry, batch_and_key):
         p, o_state, step_idx = carry
@@ -125,7 +133,6 @@ def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha
         o_state = {"actor": new_a_opt, "critic": new_c_opt, "alpha": new_t_opt}
         return (p, o_state, step_idx + 1), (vl, pl, al)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, batches, k, step0):
         """``batches``: dict of (U, batch, ...) stacked update blocks."""
         U = batches["rewards"].shape[0]
@@ -137,6 +144,12 @@ def make_sac_train_fns(actor, critic, critic_apply, actor_opt, critic_opt, alpha
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
 
+    train_phase = compile_once(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
     return act_fn, train_phase
 
 

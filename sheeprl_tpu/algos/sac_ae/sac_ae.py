@@ -17,7 +17,6 @@ jitted dispatch per ratio window.
 from __future__ import annotations
 
 import os
-from functools import partial
 from typing import Any, Dict
 
 import gymnasium as gym
@@ -42,6 +41,7 @@ from sheeprl_tpu.utils.utils import (
     Ratio,
     TrainWindow,
     merge_framestack,
+    mirror_hbm_bytes_per_update,
     probe_bytes_per_update,
     save_configs,
     window_chunks,
@@ -132,13 +132,21 @@ def main(fabric: Any, cfg: Any) -> None:
     def to_env_actions(a: np.ndarray) -> np.ndarray:
         return act_low + (a + 1.0) * 0.5 * (act_high - act_low)
 
-    @partial(jax.jit, static_argnames=("greedy",))
     def act_fn(p, obs, k, greedy=False):
         # key advances INSIDE the jitted step (one host dispatch per env step)
         k_sample, k_next = jax.random.split(k)
         feats = encoder.apply(p["encoder"], obs)
         a, _ = sample_action(actor, p["actor"], feats, k_sample, greedy=greedy)
         return a, k_next
+
+    # compile-once routing: AOT-compiled per abstract signature, counted by
+    # the recompile detector
+    act_fn = fabric.compile(
+        act_fn,
+        name=f"{cfg.algo.name}.act_fn",
+        static_argnames=("greedy",),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     player_params = psync.init(params)
 
@@ -266,7 +274,6 @@ def main(fabric: Any, cfg: Any) -> None:
         }
         return (p, o_state, step_idx + 1), (vl, pl, al, dl)
 
-    @partial(jax.jit, donate_argnums=(0, 1))
     def train_phase(p, o_state, batches, k, step0):
         U = batches["rewards"].shape[0]
         keys = jax.random.split(k, U)
@@ -274,6 +281,13 @@ def main(fabric: Any, cfg: Any) -> None:
             one_update, (p, o_state, step0), (batches, keys), unroll=bool(cnn_keys)
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), losses)
+
+    train_phase = fabric.compile(
+        train_phase,
+        name=f"{cfg.algo.name}.train_phase",
+        donate_argnums=(0, 1),
+        max_recompiles=cfg.algo.get("max_recompiles"),
+    )
 
     # ---------------- counters / buffer --------------------------------------
     # GLOBAL env-step accounting: every process steps its own envs
@@ -330,6 +344,7 @@ def main(fabric: Any, cfg: Any) -> None:
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
     bytes_per_update = None  # probed at the first train window (window_chunks)
+    mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
     player_key = jax.device_put(jax.random.fold_in(key, rank), host)
@@ -383,20 +398,35 @@ def main(fabric: Any, cfg: Any) -> None:
                     # (utils.window_chunks) — pixel next_obs pairs double the
                     # shipped bytes, so the first repaid window can otherwise
                     # exceed HBM
+                    sample_keys = None
+                    if mirror_on:
+                        sample_keys = tuple(
+                            src
+                            for k in mlp_keys
+                            for src in (k, f"next_{k}")
+                        ) + ("actions", "rewards", "terminated")
                     if bytes_per_update is None:
-                        bytes_per_update = probe_bytes_per_update(rb, batch_size)
+                        # probe only the keys that ship over H2D (mirror
+                        # pixels are gathered on device — see the dreamer
+                        # loop's note); the gathered block is budgeted
+                        # against HBM separately by window_chunks
+                        bytes_per_update = probe_bytes_per_update(
+                            rb, batch_size, keys=sample_keys
+                        )
+                        if mirror_on:
+                            # rows=2: obs + next_obs rows both gather
+                            mirror_hbm_bytes = mirror_hbm_bytes_per_update(
+                                obs_space, cnn_keys, batch_size, rows=2
+                            )
                     # one player sync per ratio window, not per chunk (a
                     # per-chunk refresh pulls full player params D2H each
                     # time — see the dreamer loop's note)
                     player_params = psync.before_dispatch(player_params)
-                    for u in window_chunks(per_rank_gradient_steps, bytes_per_update):
-                        sample_keys = None
-                        if mirror_on:
-                            sample_keys = tuple(
-                                src
-                                for k in mlp_keys
-                                for src in (k, f"next_{k}")
-                            ) + ("actions", "rewards", "terminated")
+                    for u in window_chunks(
+                        per_rank_gradient_steps,
+                        bytes_per_update,
+                        hbm_bytes_per_update=mirror_hbm_bytes,
+                    ):
                         sample = rb.sample(batch_size, n_samples=u, keys=sample_keys)
                         batches: Dict[str, jax.Array] = {
                             "actions": jnp.asarray(sample["actions"]),
@@ -412,7 +442,11 @@ def main(fabric: Any, cfg: Any) -> None:
                         for k in cnn_keys if not mirror_on else ():
                             for src in (k, f"next_{k}"):
                                 x = np.asarray(sample[src])
-                                if x.ndim == 7:
+                                # framestacked sample is (U, B, S, H, W, C) =
+                                # 6-dim — the old `== 7` guard could never
+                                # fire, shipping unmerged stacks into the
+                                # encoder; match the mirror path above
+                                if x.ndim >= 6:
                                     x = merge_framestack(x)
                                 batches[src] = jnp.asarray(x)  # uint8; /255 on device
                         for k in mlp_keys:

@@ -75,6 +75,49 @@ def _resolve_accelerator(accelerator: str) -> str:
 _FORCED_CPU_PLATFORM = False
 
 
+def ensure_compilation_cache() -> Optional[str]:
+    """Default-on persistent XLA compilation cache (compile-once hygiene).
+
+    Every Fabric construction — including CPU dryruns and tests, which
+    historically ran cache-less and re-paid every compile per process —
+    points JAX at a persistent cache directory unless one is already
+    configured.  Resolution order:
+
+    * an explicit ``fabric.compilation_cache_dir`` config (``build_fabric``)
+      or a prior ``jax.config`` update wins;
+    * ``SHEEPRL_COMPILE_CACHE`` overrides the location; setting it to ``""``
+      or ``0`` disables the default entirely;
+    * otherwise ``/tmp/sheeprl_tpu_compile_cache.<uid>`` (per-user so a
+      shared host can't poison another user's cache).
+
+    JAX's own min-compile-time threshold (default ~1s, override via
+    ``JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS``) keeps tiny test
+    programs out of the cache; only the expensive train-phase programs are
+    persisted and re-used across processes/rounds.
+    Returns the active cache dir, or None when disabled.
+    """
+    current = jax.config.jax_compilation_cache_dir
+    if current:
+        return current
+    env = os.environ.get("SHEEPRL_COMPILE_CACHE")
+    if env is not None and env.strip() in ("", "0", "off", "none"):
+        return None
+    if env:
+        cache_dir = env
+    else:
+        uid = os.getuid() if hasattr(os, "getuid") else "u"
+        import tempfile
+
+        cache_dir = os.path.join(
+            tempfile.gettempdir(), f"sheeprl_tpu_compile_cache.{uid}"
+        )
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+    except Exception:
+        return None
+    return cache_dir
+
+
 class Fabric:
     """Runtime facade handed to every algorithm ``main(fabric, cfg)``."""
 
@@ -94,6 +137,7 @@ class Fabric:
         self.precision = Precision.from_string(precision)
         self.callbacks: List[Any] = []
         self._callback_cfg = callbacks or {}
+        ensure_compilation_cache()
 
         global _FORCED_CPU_PLATFORM
         if accelerator == "cpu":
@@ -371,10 +415,121 @@ class Fabric:
             static_argnums=static_argnums,
         )
 
+    def compile(
+        self,
+        fn: Callable,
+        *,
+        name: Optional[str] = None,
+        static_argnums: Tuple[int, ...] = (),
+        static_argnames: Tuple[str, ...] = (),
+        donate_argnums: Tuple[int, ...] = (),
+        in_shardings: Any = None,
+        out_shardings: Any = None,
+        max_recompiles: Optional[int] = None,
+    ) -> Any:
+        """The compile-once entry point (see ``parallel/compile.py``):
+        returns an :class:`~sheeprl_tpu.parallel.compile.AOTFunction` whose
+        executables are AOT-lowered/compiled per abstract signature, counted
+        in the recompile detector, and warmable from :attr:`compile_pool`.
+        Drop-in replacement for decorating ``fn`` with ``jax.jit``."""
+        from sheeprl_tpu.parallel.compile import compile_once
+
+        return compile_once(
+            fn,
+            name=name,
+            static_argnums=static_argnums,
+            static_argnames=static_argnames,
+            donate_argnums=donate_argnums,
+            in_shardings=in_shardings,
+            out_shardings=out_shardings,
+            max_recompiles=max_recompiles,
+        )
+
+    @property
+    def compile_pool(self) -> Any:
+        """Process-wide parallel compile warm-up pool (lazily created)."""
+        from sheeprl_tpu.parallel.compile import get_compile_pool
+
+        return get_compile_pool()
+
     # -- host collectives --------------------------------------------------
+    #
+    # Two transports:
+    # * TPU pods: XLA collectives over ICI/DCN via ``multihost_utils`` —
+    #   native, fast, and the path real deployments exercise.
+    # * CPU multiprocess (the test rig): the ``jax.distributed``
+    #   coordination-service KV store.  XLA-CPU gloo collectives silently
+    #   zero-fill payloads when the host is CPU-oversubscribed (observed on
+    #   a 2-core container: the int64 length psum lands, the back-to-back
+    #   uint8 payload psum arrives all-zero on non-source ranks, no error
+    #   raised) — host OBJECT exchange is control-plane traffic, which the
+    #   coordination service transports reliably over gRPC.
+    _kv_seq: int = 0
+
+    def _coordination_client(self) -> Any:
+        """The jax.distributed KV client when host objects should ride it
+        (CPU backend + real multiprocess), else None."""
+        if self.num_processes == 1 or self.accelerator != "cpu":
+            return None
+        from jax._src import distributed
+
+        return distributed.global_state.client
+
+    @staticmethod
+    def _kv_timeout_ms() -> int:
+        # generous: a trainer blocks here for a full player rollout in the
+        # dedicated decoupled topology
+        return int(float(os.environ.get("SHEEPRL_KV_TIMEOUT_S", 600)) * 1000)
+
+    def _next_kv_seq(self) -> int:
+        # collective calls execute in the same order on every rank, so a
+        # per-rank counter stays in lockstep and namespaces each exchange
+        seq, self._kv_seq = self._kv_seq, self._kv_seq + 1
+        return seq
+
+    def _kv_all_gather(self, client: Any, obj: Any) -> List[Any]:
+        seq, timeout = self._next_kv_seq(), self._kv_timeout_ms()
+        prefix = f"sheeprl_tpu/ag/{seq}"
+        mine = f"{prefix}/{self.global_rank:08d}"
+        client.key_value_set_bytes(mine, bytes(_pickle_to_u8(obj).tobytes()))
+        out = [
+            _u8_to_obj(
+                np.frombuffer(
+                    client.blocking_key_value_get_bytes(f"{prefix}/{r:08d}", timeout),
+                    dtype=np.uint8,
+                )
+            )
+            for r in range(self.num_processes)
+        ]
+        # every rank has read every entry once the barrier clears; each rank
+        # deletes its own key so the KV store stays bounded on long runs
+        client.wait_at_barrier(f"{prefix}/done", timeout)
+        client.key_value_delete(mine)
+        return out
+
+    def _kv_broadcast(self, client: Any, obj: Any, src: int) -> Any:
+        seq, timeout = self._next_kv_seq(), self._kv_timeout_ms()
+        key = f"sheeprl_tpu/bc/{seq}"
+        if self.global_rank == src:
+            client.key_value_set_bytes(key, bytes(_pickle_to_u8(obj).tobytes()))
+            out = obj
+        else:
+            out = _u8_to_obj(
+                np.frombuffer(
+                    client.blocking_key_value_get_bytes(key, timeout), dtype=np.uint8
+                )
+            )
+        client.wait_at_barrier(f"{key}/done", timeout)
+        if self.global_rank == src:
+            client.key_value_delete(key)
+        return out
+
     def all_gather_object(self, obj: Any) -> List[Any]:
         if self.num_processes == 1:
             return [obj]
+        client = self._coordination_client()
+        if client is not None:
+            return self._kv_all_gather(client, obj)
         from jax.experimental import multihost_utils
 
         payload = _pickle_to_u8(obj)
@@ -394,6 +549,9 @@ class Fabric:
     def broadcast_object(self, obj: Any, src: int = 0) -> Any:
         if self.num_processes == 1:
             return obj
+        client = self._coordination_client()
+        if client is not None:
+            return self._kv_broadcast(client, obj, src)
         from jax.experimental import multihost_utils
 
         is_source = self.global_rank == src
@@ -415,6 +573,12 @@ class Fabric:
 
     def barrier(self) -> None:
         if self.num_processes > 1:
+            client = self._coordination_client()
+            if client is not None:
+                client.wait_at_barrier(
+                    f"sheeprl_tpu/barrier/{self._next_kv_seq()}", self._kv_timeout_ms()
+                )
+                return
             from jax.experimental import multihost_utils
 
             multihost_utils.sync_global_devices("sheeprl_tpu_barrier")
@@ -597,7 +761,18 @@ def build_fabric(cfg: Any) -> Fabric:
         # not once per process — essential for short driver/bench runs.
         # (The min-compile-time threshold is left at JAX's default so the
         # JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS env override is honored.)
-        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+        if jax.config.jax_compilation_cache_dir != str(cache_dir):
+            jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+            # JAX memoizes the cache backend on first use; with the
+            # default-on cache (ensure_compilation_cache) an earlier Fabric
+            # may have initialized it at another path — drop it so the
+            # explicitly configured directory actually receives entries
+            try:
+                from jax._src.compilation_cache import reset_cache
+
+                reset_cache()
+            except Exception:
+                pass
     fabric = Fabric(
         devices=fab_cfg.get("devices", 1),
         num_nodes=fab_cfg.get("num_nodes", 1),

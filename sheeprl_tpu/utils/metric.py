@@ -162,6 +162,14 @@ def flush_metrics(
     if extra_metrics:
         metrics.update(extra_metrics)
     metrics.update(times)
+    # compile-once layer accounting: cumulative executable count + compile
+    # seconds (utils/profiler.py COMPILE_MONITOR).  A count that keeps
+    # growing after warm-up IS the recompile pathology the detector exists
+    # for — surfacing it in the normal metric stream makes it visible in
+    # TensorBoard without a debugger attached.
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+
+    metrics.update(COMPILE_MONITOR.compile_metrics())
     if logger is not None and metrics:
         logger.log_metrics(metrics, policy_step)
     return policy_step

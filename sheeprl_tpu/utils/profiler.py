@@ -1,4 +1,4 @@
-"""Profiler gating for train loops.
+"""Profiler gating for train loops + compile accounting.
 
 The reference has no profiler integration (SURVEY.md §5.1 — named timers
 only); on TPU a ``jax.profiler`` trace is the difference between guessing
@@ -11,12 +11,148 @@ H2D gaps), so the TPU framework makes it a config switch:
 captures updates [start, stop) into ``<log_dir>/profiler`` (viewable with
 TensorBoard's profile plugin / xprof).  Updates before ``start_update``
 are skipped so compilation and warm-up never pollute the trace.
+
+This module also hosts the **recompile detector** of the compile-once
+execution layer (``parallel/compile.py``): every AOT lowering/compilation
+performed through ``fabric.compile`` records a (function, abstract
+signature) event into the process-global :data:`COMPILE_MONITOR`.  A
+recompile — any compile of a named function beyond its first — means the
+caller fed a new shape/dtype/sharding signature into a supposedly
+compile-once program (last-batch remainders, framestack variants, drifting
+scalar dtypes...).  ``max_recompiles`` (per function, or globally via
+``SHEEPRL_MAX_RECOMPILES``) turns that from a silent multi-minute TPU stall
+into a hard :class:`RecompileLimitExceeded` with the full signature history
+attached.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class RecompileLimitExceeded(RuntimeError):
+    """A compile-once function exceeded its allowed recompile budget."""
+
+
+class CompileMonitor:
+    """Process-global per-function compile counter + abstract-signature log.
+
+    ``count(name)`` is the number of executables built for ``name`` — the
+    first compile is expected; every further one is a *recompile* caused by
+    a new abstract signature.  The ``max_recompiles`` budget itself is
+    enforced per-``AOTFunction`` instance (see ``parallel/compile.py``),
+    which raises :class:`RecompileLimitExceeded`; this monitor is the
+    process-wide aggregate view (metrics, dryrun stage summaries).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._stats: Dict[str, Dict[str, Any]] = {}
+
+    # -- recording (called by parallel.compile.AOTFunction) -----------------
+    def begin(self, name: str, signature: Any) -> None:
+        """Count one compile of ``name`` in the process-global accounting.
+
+        Pure bookkeeping: the ``max_recompiles`` budget is enforced
+        per-:class:`~sheeprl_tpu.parallel.compile.AOTFunction` *instance*
+        (each instance IS one compile-once program).  The global per-name
+        count would otherwise aggregate across unrelated instances that
+        happen to share a name — e.g. every run constructed in the same
+        test process — and trip the budget for compiles the current
+        program never performed.
+        """
+        with self._lock:
+            st = self._stats.setdefault(
+                name, {"count": 0, "seconds": 0.0, "signatures": []}
+            )
+            st["count"] += 1
+            st["signatures"].append(str(signature))
+
+    def abort(self, name: str, signature: Any = None) -> None:
+        """Roll back one ``begin`` for ``name``: the compile failed, so no
+        executable exists — counters must reflect programs actually built.
+        When ``signature`` is given, the MATCHING history entry (searched
+        from the end) is removed rather than blindly the last one, since two
+        signatures of one function can compile concurrently."""
+        with self._lock:
+            st = self._stats.get(name)
+            if st is None or st["count"] <= 0:
+                return
+            st["count"] -= 1
+            if not st["signatures"]:
+                return
+            if signature is None:
+                st["signatures"].pop()
+                return
+            sig_str = str(signature)
+            for i in range(len(st["signatures"]) - 1, -1, -1):
+                if st["signatures"][i] == sig_str:
+                    del st["signatures"][i]
+                    break
+
+    def end(self, name: str, seconds: float) -> None:
+        with self._lock:
+            st = self._stats.get(name)
+            if st is not None:
+                st["seconds"] += float(seconds)
+
+    @staticmethod
+    def default_limit() -> Optional[int]:
+        raw = os.environ.get("SHEEPRL_MAX_RECOMPILES", "").strip()
+        return int(raw) if raw else None
+
+    # -- queries -------------------------------------------------------------
+    def count(self, name: str) -> int:
+        with self._lock:
+            return int(self._stats.get(name, {}).get("count", 0))
+
+    def signatures(self, name: str) -> List[str]:
+        with self._lock:
+            return list(self._stats.get(name, {}).get("signatures", ()))
+
+    def totals(self) -> Tuple[int, float]:
+        """(total executables compiled, total compile seconds)."""
+        with self._lock:
+            return (
+                sum(st["count"] for st in self._stats.values()),
+                sum(st["seconds"] for st in self._stats.values()),
+            )
+
+    def summary(self) -> Dict[str, Dict[str, Any]]:
+        with self._lock:
+            return {
+                name: {
+                    "count": st["count"],
+                    "seconds": round(st["seconds"], 3),
+                    "signatures": list(st["signatures"]),
+                }
+                for name, st in self._stats.items()
+            }
+
+    def delta_report(self, mark: Tuple[int, float]) -> str:
+        """One human line of what compiled since ``mark`` (from totals())."""
+        count, seconds = self.totals()
+        return f"{count - mark[0]} executables / {seconds - mark[1]:.1f}s compile"
+
+    def compile_metrics(self) -> Dict[str, float]:
+        """Aggregate counters for the metric flush (see metric.flush_metrics)."""
+        count, seconds = self.totals()
+        if count == 0:
+            return {}
+        return {
+            "Compile/executables": float(count),
+            "Compile/compile_time_s": round(seconds, 3),
+        }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._stats.clear()
+
+
+#: The process-global monitor every AOTFunction reports into.
+COMPILE_MONITOR = CompileMonitor()
 
 
 class ProfilerGate:

@@ -237,7 +237,29 @@ def probe_bytes_per_update(rb, batch_size: int, **sample_kwargs) -> float:
     return float(sum(np.asarray(v).nbytes for v in probe.values()))
 
 
-def window_chunks(n_updates: int, bytes_per_update: float, budget_bytes: Optional[float] = None):
+def mirror_hbm_bytes_per_update(
+    obs_space: Any, cnn_keys, batch_size: int, rows: int = 1
+) -> float:
+    """Per-update HBM bytes of the device-GATHERED pixel block when the
+    replay mirror is on (the pixels never ship H2D; the ring is uint8, so
+    1 byte/px).  ``rows`` is how many gathered pixel rows each sampled
+    transition contributes: the sequence length for sequential samplers
+    (Dreamer), 2 for transition samplers that gather obs + next_obs
+    (SAC-AE).  Feed the result to ``window_chunks(hbm_bytes_per_update=...)``
+    so both loops budget the same formula."""
+    return float(
+        sum(int(np.prod(obs_space[k].shape)) for k in cnn_keys)
+        * int(rows)
+        * int(batch_size)
+    )
+
+
+def window_chunks(
+    n_updates: int,
+    bytes_per_update: float,
+    budget_bytes: Optional[float] = None,
+    hbm_bytes_per_update: float = 0.0,
+):
     """Split an update window into dispatch chunk sizes whose shipped
     ``(U, ...)`` batch block stays under a device byte budget.
 
@@ -258,10 +280,21 @@ def window_chunks(n_updates: int, bytes_per_update: float, budget_bytes: Optiona
     a burst must reuse a handful of shapes rather than mint arbitrary ones
     (and the small tail chunks coincide with the steady-state window sizes,
     which are also tiny powers of two).
+
+    ``bytes_per_update`` is the SHIPPED (H2D) cost of one update's batch.
+    With the device mirror, pixel sequences never ship — but the on-device
+    gathered ``(U, ...)`` pixel block still consumes HBM; pass its per-update
+    bytes as ``hbm_bytes_per_update`` so the chunk cap honors BOTH ceilings
+    (``SHEEPRL_MAX_HBM_WINDOW_BYTES``, default 2 GiB — the gathered block
+    lives on-chip only, no padded-H2D-layout 2x, so it gets a looser cap
+    than the shipped budget).
     """
     if budget_bytes is None:
         budget_bytes = float(os.environ.get("SHEEPRL_MAX_WINDOW_BYTES", 2**30))
     max_u = max(1, int(budget_bytes // max(bytes_per_update, 1.0)))
+    if hbm_bytes_per_update > 0.0:
+        hbm_budget = float(os.environ.get("SHEEPRL_MAX_HBM_WINDOW_BYTES", 2**31))
+        max_u = min(max_u, max(1, int(hbm_budget // hbm_bytes_per_update)))
     cap = 1 << (max_u.bit_length() - 1)  # largest power of two <= max_u
     chunks = []
     remaining = int(n_updates)
@@ -461,6 +494,12 @@ def device_sync(tree: Any = None) -> None:
             # different devices (or shardings) would raise and silently void
             # the fence on the one backend that needs it
             key = tuple(sorted((d.platform, d.id) for d in leaf.devices()))
+            if jnp.issubdtype(leaf.dtype, jax.dtypes.extended):
+                # typed PRNG key arrays (and other extended dtypes) have no
+                # float32 cast — fence their uint32 key-data view instead of
+                # skipping the leaf: RNG state threaded through the timed
+                # program must hold the fence like any other output
+                leaf = jax.random.key_data(leaf)
             groups.setdefault(key, []).append(jnp.ravel(leaf)[:1].astype(jnp.float32))
         except Exception:
             continue

@@ -188,9 +188,13 @@ def test_uniform_mirror_resume_resync():
 
 
 @pytest.mark.slow
-def test_sac_ae_e2e_mirror_equivalence(tmp_path):
+@pytest.mark.parametrize("frame_stack", [1, 2])
+def test_sac_ae_e2e_mirror_equivalence(tmp_path, frame_stack):
     """SAC-AE dry run with the mirror ON equals the host-ship path
-    bit-for-bit (same draws, same bytes)."""
+    bit-for-bit (same draws, same bytes).  ``frame_stack=2`` covers the
+    stacked-pixels layout: the host-ship path merges the (U, B, S, H, W, C)
+    sample with ``ndim >= 6`` (a ``== 7`` guard used to never fire there,
+    feeding the encoder unmerged stacks only on the host path)."""
     from tests.test_regression.test_golden import COMMON, FAMILIES, _last_metrics
     from sheeprl_tpu.cli import run
 
@@ -200,7 +204,11 @@ def test_sac_ae_e2e_mirror_equivalence(tmp_path):
         run(
             COMMON
             + FAMILIES["sac_ae"]
-            + [f"buffer.device_mirror={mirror}", f"log_dir={logs}"]
+            + [
+                f"env.frame_stack={frame_stack}",
+                f"buffer.device_mirror={mirror}",
+                f"log_dir={logs}",
+            ]
         )
         results[mirror] = _last_metrics(logs)
     assert results["False"] and results["False"] == results["True"]

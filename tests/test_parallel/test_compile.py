@@ -1,0 +1,325 @@
+"""Compile-once execution layer (parallel/compile.py + the recompile
+detector in utils/profiler.py).
+
+Covers the contract the layer exists to enforce:
+* a second same-signature call dispatches the cached executable (no
+  recompile counted);
+* a changed-shape call IS counted and trips ``max_recompiles`` when
+  configured;
+* the AOT-compiled path is numerically equivalent to the implicit
+  ``jax.jit`` path on a real algorithm update (SAC);
+* ``max_recompiles`` is enforced end-to-end on the DreamerV3 and PPO train
+  loops (the acceptance surface for shape drift: last-batch remainders,
+  framestack variants).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.parallel.compile import AOTFunction, CompilePool, compile_once
+from sheeprl_tpu.utils.profiler import CompileMonitor, RecompileLimitExceeded
+
+
+def _make(fn, **kwargs):
+    return AOTFunction(fn, monitor=CompileMonitor(), **kwargs)
+
+
+# ---- detector unit behavior -------------------------------------------------
+
+
+def test_same_signature_does_not_recompile():
+    aot = _make(lambda x: x * 2.0, name="double")
+    a = aot(jnp.ones((4,)))
+    b = aot(jnp.ones((4,)) + 1.0)
+    np.testing.assert_allclose(np.asarray(a), 2.0)
+    np.testing.assert_allclose(np.asarray(b), 4.0)
+    assert aot._monitor.count("double") == 1
+    assert aot.cache_size() == 1
+
+
+def test_changed_shape_is_counted():
+    aot = _make(lambda x: x.sum(), name="summer")
+    aot(jnp.ones((4,)))
+    aot(jnp.ones((8,)))  # new abstract signature -> second executable
+    assert aot._monitor.count("summer") == 2
+    assert len(aot._monitor.signatures("summer")) == 2
+
+
+def test_changed_dtype_is_counted():
+    aot = _make(lambda x: x + 1, name="inc")
+    aot(jnp.ones((4,), jnp.float32))
+    aot(jnp.ones((4,), jnp.int32))
+    assert aot._monitor.count("inc") == 2
+
+
+def test_max_recompiles_trips():
+    aot = _make(lambda x: x * 1.0, name="capped", max_recompiles=0)
+    aot(jnp.ones((4,)))  # first compile is free
+    with pytest.raises(RecompileLimitExceeded) as exc:
+        aot(jnp.ones((5,)))
+    # the error must carry the signature history for diagnosis
+    assert "signature history" in str(exc.value)
+    # a budget of 1 allows exactly one recompile
+    aot2 = _make(lambda x: x * 1.0, name="capped2", max_recompiles=1)
+    aot2(jnp.ones((4,)))
+    aot2(jnp.ones((5,)))
+    with pytest.raises(RecompileLimitExceeded):
+        aot2(jnp.ones((6,)))
+
+
+def test_guard_fires_before_paying_for_the_compile():
+    """Tripping the budget must not first build the offending executable."""
+    calls = []
+
+    def fn(x):
+        calls.append(1)  # traced once per compile
+        return x
+
+    aot = _make(fn, name="pretrace", max_recompiles=0)
+    aot(jnp.ones((2,)))
+    traced = len(calls)
+    with pytest.raises(RecompileLimitExceeded):
+        aot(jnp.ones((3,)))
+    assert len(calls) == traced  # the second shape was never traced/compiled
+
+
+def test_env_default_limit(monkeypatch):
+    monkeypatch.setenv("SHEEPRL_MAX_RECOMPILES", "0")
+    aot = _make(lambda x: x, name="envcap")
+    aot(jnp.ones((2,)))
+    with pytest.raises(RecompileLimitExceeded):
+        aot(jnp.ones((3,)))
+
+
+def test_static_args_key_by_value():
+    """Static args (by name, positionally or as kwargs) select distinct
+    executables keyed by VALUE — never silently reuse across values."""
+    aot = _make(
+        lambda x, mode=False: x * 2.0 if mode else x + 1.0,
+        name="static",
+        static_argnames=("mode",),
+    )
+    x = jnp.ones((3,))
+    np.testing.assert_allclose(np.asarray(aot(x)), 2.0)
+    np.testing.assert_allclose(np.asarray(aot(x, mode=True)), 2.0)
+    np.testing.assert_allclose(np.asarray(aot(x, True)), 2.0)  # positional
+    np.testing.assert_allclose(np.asarray(aot(x, False)), 2.0)
+    # kwarg-True and positional-True share one executable; False adds one
+    assert aot._monitor.count("static") == 2
+
+
+def test_tracer_arguments_inline():
+    """Inside another jitted program the wrapper must inline like plain jit."""
+    inner = _make(lambda x: x * 3.0, name="inner")
+
+    @jax.jit
+    def outer(x):
+        return inner(x) + 1.0
+
+    np.testing.assert_allclose(np.asarray(outer(jnp.ones((2,)))), 4.0)
+    assert inner._monitor.count("inner") == 0  # inlined, never AOT-compiled
+
+
+def test_donated_buffers_update_equivalence():
+    """donate_argnums through the AOT path behaves like plain jit."""
+    aot = _make(lambda s, d: (s + d, d), name="donate", donate_argnums=(0,))
+    s, out = aot(jnp.zeros((4,)), jnp.ones((4,)))
+    s, out = aot(s, out)
+    np.testing.assert_allclose(np.asarray(s), 2.0)
+    assert aot._monitor.count("donate") == 1
+
+
+# ---- warm-up pool -----------------------------------------------------------
+
+
+def test_warmup_pool_compiles_without_executing():
+    ran = []
+
+    def fn(x):
+        ran.append(1)  # appended per trace, not per execution
+        return x * 5.0
+
+    aot = _make(fn, name="warm")
+    pool = CompilePool(max_workers=2)
+    fut = pool.submit(aot, jax.ShapeDtypeStruct((4,), jnp.float32))
+    pool.join()
+    assert fut.done() and aot._monitor.count("warm") == 1
+    # the real call hits the warmed executable: no second compile
+    out = aot(jnp.ones((4,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(out), 5.0)
+    assert aot._monitor.count("warm") == 1
+    pool.shutdown()
+
+
+def test_warmup_failure_degrades_but_limit_is_hard():
+    pool = CompilePool(max_workers=1)
+    pool.submit_fn(lambda: (_ for _ in ()).throw(ValueError("benign")))
+    pool.join()  # benign warm-up failures are swallowed
+
+    def boom():
+        raise RecompileLimitExceeded("hard")
+
+    pool.submit_fn(boom)
+    with pytest.raises(RecompileLimitExceeded):
+        pool.join()
+    pool.shutdown()
+
+
+# ---- AOT vs implicit-jit equivalence on a real algorithm update -------------
+
+
+def _tiny_sac():
+    from sheeprl_tpu.algos.sac.agent import build_agent as sac_build_agent
+    from sheeprl_tpu.algos.sac.sac import make_sac_train_fns
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.utils.optim import build_optimizer
+
+    cfg = compose(
+        [
+            "exp=sac",
+            "env=dummy",
+            "env.id=continuous_dummy",
+            "algo.hidden_size=16",
+            "algo.mlp_keys.encoder=[state]",
+        ]
+    )
+    fabric = Fabric(devices=1, accelerator="cpu")
+    obs_dim, act_dim = 4, 2
+    actor, critic, params = sac_build_agent(fabric, act_dim, cfg, obs_dim, None)
+    actor_opt = build_optimizer(cfg.algo.actor.optimizer)
+    critic_opt = build_optimizer(cfg.algo.critic.optimizer)
+    alpha_opt = build_optimizer(cfg.algo.alpha.optimizer)
+    opt_state = fabric.replicate(
+        {
+            "actor": actor_opt.init(params["actor"]),
+            "critic": critic_opt.init(params["critic"]),
+            "alpha": alpha_opt.init(params["log_alpha"]),
+        }
+    )
+
+    def plain_apply(critic_mod, cp, o, a, k):
+        return critic_mod.apply(cp, o, a)
+
+    _, train_phase = make_sac_train_fns(
+        actor, critic, plain_apply, actor_opt, critic_opt, alpha_opt, cfg, act_dim
+    )
+    U, bs = 2, 8
+    rng = np.random.default_rng(3)
+    batches = {
+        "obs": jnp.asarray(rng.normal(size=(U, bs, obs_dim)).astype(np.float32)),
+        "next_obs": jnp.asarray(rng.normal(size=(U, bs, obs_dim)).astype(np.float32)),
+        "actions": jnp.asarray(rng.uniform(-1, 1, (U, bs, act_dim)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(U, bs)).astype(np.float32)),
+        "terminated": jnp.zeros((U, bs), jnp.float32),
+    }
+    return train_phase, params, opt_state, batches
+
+
+def test_aot_equals_implicit_jit_on_sac_update():
+    """The AOT-compiled SAC train phase returns the same params/losses as
+    the implicit-jit path — the executable runs the identical program, only
+    the compile cadence differs."""
+    train_phase, params, opt_state, batches = _tiny_sac()
+    copy = lambda t: jax.tree.map(jnp.array, t)  # donate_argnums=(0, 1)
+    k, step = jax.random.PRNGKey(9), jnp.int32(0)
+    p_aot, _, losses_aot = train_phase(copy(params), copy(opt_state), batches, k, step)
+    p_jit, _, losses_jit = train_phase.jitted(copy(params), copy(opt_state), batches, k, step)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6),
+        (p_aot, losses_aot),
+        (p_jit, losses_jit),
+    )
+
+
+# ---- loop-level enforcement (DreamerV3 + PPO) -------------------------------
+
+
+def _monitor_count(name):
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+
+    return COMPILE_MONITOR.count(name)
+
+
+def test_ppo_loop_respects_max_recompiles(tmp_path):
+    """A PPO dry run under a finite recompile budget completes, and its
+    programs are visible in the process-global recompile detector."""
+    from tests.test_algos.test_algos import standard_args
+    from sheeprl_tpu.cli import run
+
+    before = _monitor_count("ppo.train_phase")
+    run(
+        standard_args(
+            tmp_path,
+            extra=[
+                "exp=ppo",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "algo.rollout_steps=8",
+                "algo.per_rank_batch_size=8",
+                "algo.update_epochs=1",
+                "algo.mlp_keys.encoder=[state]",
+                "env.max_episode_steps=16",
+                "algo.max_recompiles=4",
+                "algo.run_test=False",
+            ],
+        )
+    )
+    after = _monitor_count("ppo.train_phase")
+    assert 1 <= after - before <= 5  # compiled, and within budget (first free)
+
+
+def test_ppo_loop_completes_under_zero_budget(tmp_path):
+    """The strict compile-once contract is USABLE: a drift-free PPO dry run
+    completes under max_recompiles=0.  In particular the placement
+    ping-pong between the loop's initial host-committed key and the
+    executable-returned one canonicalizes to ONE signature
+    (_canon_placement) instead of burning a duplicate compile — shape/dtype
+    drift still trips, as the unit tests above pin."""
+    from tests.test_algos.test_algos import standard_args
+    from sheeprl_tpu.cli import run
+
+    run(
+        standard_args(
+            tmp_path,
+            extra=[
+                "exp=ppo",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                "algo.rollout_steps=8",
+                "algo.per_rank_batch_size=8",
+                "algo.update_epochs=1",
+                "algo.mlp_keys.encoder=[state]",
+                "env.max_episode_steps=16",
+                "algo.max_recompiles=0",
+                "algo.run_test=False",
+            ],
+        )
+    )
+
+
+@pytest.mark.slow
+def test_dreamer_v3_loop_respects_max_recompiles(tmp_path):
+    from tests.test_algos.test_algos import DV3_XS_ARGS, standard_args
+    from sheeprl_tpu.cli import run
+
+    before = _monitor_count("dreamer_v3.train_phase")
+    run(
+        standard_args(
+            tmp_path,
+            extra=[
+                "exp=dreamer_v3",
+                "env=dummy",
+                "env.id=discrete_dummy",
+                *DV3_XS_ARGS,
+                "algo.max_recompiles=8",
+                "algo.run_test=False",
+            ],
+        )
+    )
+    after = _monitor_count("dreamer_v3.train_phase")
+    assert 1 <= after - before <= 9
+    assert _monitor_count("dreamer_v3.player_step") >= 1

@@ -20,6 +20,10 @@ _WORKER = textwrap.dedent(
     import os, sys
     import jax
     jax.config.update("jax_platforms", "cpu")
+    # cross-process CPU computations need explicit collectives (default
+    # "none" raises "Multiprocess computations aren't implemented on the
+    # CPU backend" from the first broadcast)
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
     jax.distributed.initialize(
         coordinator_address=os.environ["COORD"],
         num_processes=2,
