@@ -116,6 +116,7 @@ def dreamer_family_loop(
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -141,6 +142,9 @@ def dreamer_family_loop(
     state: Dict[str, Any] = dict(initial_state or {})
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
+    if state and state.get("key") is not None:
+        # resume the train-dispatch RNG stream bit-exactly (rank-identical)
+        key = jnp.asarray(state["key"])
     world_model, actor, critic, params = build_agent_fn(
         fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
     )
@@ -300,7 +304,12 @@ def dreamer_family_loop(
     mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
     # per-rank player key stream, advanced inside player_step; the main
     # `key` stays rank-identical for train dispatches
-    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
+    player_key = jax.device_put(
+        # resume this rank's player RNG stream bit-exactly when saved
+        jnp.asarray(state["player_key"]) if state and state.get("player_key") is not None
+        else jax.random.fold_in(key, rank),
+        host,
+    )
 
     # parallel compile warm-up: the player executable lowers+compiles in the
     # pool while this thread steps random prefill actions (XLA compilation
@@ -532,13 +541,13 @@ def dreamer_family_loop(
             )
 
         # ---------------- checkpoint ------------------------------------------
-        if (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (update == total_iters and cfg.checkpoint.save_last):
+        if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": params,
                 "opt_state": opt_state,
+                "key": key,
+                "player_key": player_key,
                 "update": update,
                 "policy_step": policy_step,
                 "last_log": last_log,
@@ -553,10 +562,14 @@ def dreamer_family_loop(
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+        if ckpt_mgr.preempted:
+            fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
+            break
 
     profiler.close()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    ckpt_mgr.finalize()
+    if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         # the deferred-sync player may be one window stale: sync once more
         player_params = psync.init(params)
         test(player_test_step, player_params, cfg, log_dir, logger)

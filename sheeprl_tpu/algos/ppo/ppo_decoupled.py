@@ -248,6 +248,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -271,6 +272,11 @@ def main(fabric: Any, cfg: Any) -> None:
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
+    if state and state.get("key") is not None:
+        # resume the rollout/train RNG stream bit-exactly (this loop threads
+        # one key through collect_rollout; per-rank separation is fold_in'd
+        # inside the policy step)
+        key = jnp.asarray(state["key"])
     agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
     optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
     opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
@@ -391,13 +397,12 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/entropy_loss", ent)
             last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
 
-        if (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (update == total_iters and cfg.checkpoint.save_last):
+        if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": params,
                 "opt_state": opt_state,
+                "key": key,
                 "update": update,
                 "policy_step": policy_step,
                 "last_log": last_log,
@@ -408,9 +413,13 @@ def main(fabric: Any, cfg: Any) -> None:
                 ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
                 state=ckpt_state,
             )
+        if ckpt_mgr.preempted:
+            fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
+            break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    ckpt_mgr.finalize()
+    if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         test(agent, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()
@@ -452,6 +461,11 @@ def _dedicated_main(fabric: Any, cfg: Any) -> None:
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    # commit-protocol/async saves via the manager; cadence stays the
+    # deterministic ckpt_due below, and preemption is NOT polled here — the
+    # lockstep player↔trainer message protocol cannot tolerate one rank
+    # unilaterally breaking out (a SIGTERM usually reaches only one process)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if is_player:
         save_configs(cfg, log_dir)
 
@@ -672,6 +686,7 @@ def _dedicated_main(fabric: Any, cfg: Any) -> None:
                 },
             )
 
+    ckpt_mgr.finalize()
     if is_player:
         envs.close()
         if cfg.algo.run_test:

@@ -116,15 +116,27 @@ class RecurrentPPOAgent(nn.Module):
         is_first_seq: jax.Array,
         initial_state: Tuple[jax.Array, jax.Array],
     ) -> Tuple[jax.Array, jax.Array]:
-        """Scan over a ``(T, B, ...)`` sequence; returns (T, B, ·) heads."""
+        """Scan over a ``(T, B, ...)`` sequence; returns (T, B, ·) heads.
 
-        def body(carry, xs):
+        The time loop is flax's LIFTED scan: a raw ``jax.lax.scan`` over a
+        bound method trips linen's trace-level check (JaxTransformError —
+        submodule access from inside a jax transform); ``nn.scan`` with
+        ``variable_broadcast='params'`` shares the step's parameters across
+        the unrolled time axis, which is exactly the recurrent semantics."""
+
+        def body(mdl: "RecurrentPPOAgent", carry, xs):
             obs_t, act_t, first_t = xs
-            carry, out = self.step(carry, obs_t, act_t, first_t)
-            return carry, out
+            return mdl.step(carry, obs_t, act_t, first_t)
 
-        _, (actor_out, values) = jax.lax.scan(
-            body, initial_state, (obs_seq, prev_actions_seq, is_first_seq)
+        scan = nn.scan(
+            body,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+        )
+        _, (actor_out, values) = scan(
+            self, initial_state, (obs_seq, prev_actions_seq, is_first_seq)
         )
         return actor_out, values
 

@@ -91,6 +91,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -112,6 +113,9 @@ def main(fabric: Any, cfg: Any) -> None:
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
+    if state and state.get("key") is not None:
+        # resume the train-dispatch RNG stream bit-exactly (rank-identical)
+        key = jnp.asarray(state["key"])
     agent, params = build_agent(fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent"))
     optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
     opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
@@ -253,7 +257,12 @@ def main(fabric: Any, cfg: Any) -> None:
     last_losses = None
     # per-rank player key stream, advanced inside policy_step_fn; the main
     # `key` stays rank-identical for train dispatches
-    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
+    player_key = jax.device_put(
+        # resume this rank's player RNG stream bit-exactly when saved
+        jnp.asarray(state["player_key"]) if state and state.get("player_key") is not None
+        else jax.random.fold_in(key, rank),
+        host,
+    )
 
     # the train phase is a GLOBAL program: under multi-host the env axis is
     # the concatenation of every process's local envs.  Single-process keeps
@@ -398,13 +407,13 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/entropy_loss", el)
             last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
 
-        if (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (update == total_iters and cfg.checkpoint.save_last):
+        if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": params,
                 "opt_state": opt_state,
+                "key": key,
+                "player_key": player_key,
                 "update": update,
                 "policy_step": policy_step,
                 "last_log": last_log,
@@ -415,9 +424,13 @@ def main(fabric: Any, cfg: Any) -> None:
                 ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
                 state=ckpt_state,
             )
+        if ckpt_mgr.preempted:
+            fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
+            break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    ckpt_mgr.finalize()
+    if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         from sheeprl_tpu.algos.ppo_recurrent.utils import test
 
         test(agent, player_params, cfg, log_dir, logger)

@@ -162,6 +162,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -193,6 +194,9 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
+    if state and state.get("key") is not None:
+        # resume the train-dispatch RNG stream bit-exactly (rank-identical)
+        key = jnp.asarray(state["key"])
     actor, critic, params = build_agent_fn(fabric, act_dim, cfg, obs_dim, state.get("agent"))
 
     actor_opt = build_optimizer(cfg.algo.actor.optimizer)
@@ -263,7 +267,12 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     last_losses = None
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
-    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
+    player_key = jax.device_put(
+        # resume this rank's player RNG stream bit-exactly when saved
+        jnp.asarray(state["player_key"]) if state and state.get("player_key") is not None
+        else jax.random.fold_in(key, rank),
+        host,
+    )
 
     from sheeprl_tpu.utils.profiler import ProfilerGate
 
@@ -364,13 +373,13 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             )
 
         # ---------------- checkpoint ----------------------------------------
-        if (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (update == total_iters and cfg.checkpoint.save_last):
+        if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": params,
                 "opt_state": opt_state,
+                "key": key,
+                "player_key": player_key,
                 "update": update,
                 "policy_step": policy_step,
                 "last_log": last_log,
@@ -386,10 +395,14 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+        if ckpt_mgr.preempted:
+            fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
+            break
 
     profiler.close()
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    ckpt_mgr.finalize()
+    if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         # the deferred-sync (decoupled) player may be stale: sync once more
         player_params = psync.init(params)
         test(actor, player_params, cfg, log_dir, logger)

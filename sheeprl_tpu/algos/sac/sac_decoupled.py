@@ -81,6 +81,10 @@ def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    # commit-protocol/async saves via the manager; cadence stays the shared
+    # deterministic rule below, and preemption is NOT polled — the lockstep
+    # player↔trainer broadcasts cannot tolerate one rank breaking out
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if is_player:
         save_configs(cfg, log_dir)
 
@@ -362,5 +366,6 @@ def _dedicated_main(fabric: Any, cfg: Any, critic_apply: Any) -> None:
         envs.close()
         if cfg.algo.run_test:
             test(actor, player_params, cfg, log_dir, logger)
+    ckpt_mgr.finalize()
     if logger is not None:
         logger.close()

@@ -68,6 +68,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
+    ckpt_mgr = fabric.get_checkpoint_manager(cfg, log_dir)
     if fabric.is_global_zero:
         save_configs(cfg, log_dir)
 
@@ -93,6 +94,9 @@ def main(fabric: Any, cfg: Any) -> None:
     state: Dict[str, Any] = {}
     if cfg.checkpoint.resume_from:
         state = fabric.load(cfg.checkpoint.resume_from)
+    if state and state.get("key") is not None:
+        # resume the train-dispatch RNG stream bit-exactly (rank-identical)
+        key = jnp.asarray(state["key"])
     encoder, decoder, actor, critic, params = build_agent(
         fabric, act_dim, cfg, obs_space, state.get("agent")
     )
@@ -347,7 +351,12 @@ def main(fabric: Any, cfg: Any) -> None:
     mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
-    player_key = jax.device_put(jax.random.fold_in(key, rank), host)
+    player_key = jax.device_put(
+        # resume this rank's player RNG stream bit-exactly when saved
+        jnp.asarray(state["player_key"]) if state and state.get("player_key") is not None
+        else jax.random.fold_in(key, rank),
+        host,
+    )
 
     for update in range(start_iter, total_iters + 1):
         policy_step += num_envs * fabric.num_processes
@@ -472,13 +481,13 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/reconstruction_loss", dl)
             last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
 
-        if (
-            cfg.checkpoint.every > 0 and policy_step - last_checkpoint >= cfg.checkpoint.every
-        ) or (update == total_iters and cfg.checkpoint.save_last):
+        if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step
             ckpt_state = {
                 "agent": params,
                 "opt_state": opt_state,
+                "key": key,
+                "player_key": player_key,
                 "update": update,
                 "policy_step": policy_step,
                 "last_log": last_log,
@@ -494,9 +503,13 @@ def main(fabric: Any, cfg: Any) -> None:
                 state=ckpt_state,
                 replay_buffer=rb if cfg.buffer.checkpoint else None,
             )
+        if ckpt_mgr.preempted:
+            fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
+            break
 
     envs.close()
-    if fabric.is_global_zero and cfg.algo.run_test:
+    ckpt_mgr.finalize()
+    if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         from sheeprl_tpu.algos.sac_ae.utils import test
 
         # the deferred-sync player may be one window stale: sync once more

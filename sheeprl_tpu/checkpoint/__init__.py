@@ -1,0 +1,59 @@
+"""Fault-tolerant checkpointing subsystem (see docs/checkpointing.md).
+
+Async snapshots (``writer``), a durable multi-rank commit protocol
+(``protocol``), preemption handling (``preemption``), retention and resume
+discovery (``manager``), on shared host-serialization primitives
+(``serialize``).  The legacy ``utils.checkpoint`` module re-exports from
+here for backwards compatibility.
+"""
+
+from sheeprl_tpu.checkpoint.manager import CheckpointManager, resolve_auto_resume
+from sheeprl_tpu.checkpoint.preemption import (
+    PREEMPTION_GUARD,
+    PreemptionGuard,
+    install_preemption_handler,
+    preemption_requested,
+)
+from sheeprl_tpu.checkpoint.protocol import (
+    checkpoint_step,
+    gc_checkpoints,
+    is_committed,
+    latest_checkpoint,
+    list_checkpoints,
+    read_manifest,
+    verify_checkpoint,
+)
+from sheeprl_tpu.checkpoint.serialize import (
+    KeyArrayRef,
+    durable_write,
+    from_host_tree,
+    load_checkpoint,
+    save_checkpoint,
+    snapshot_tree,
+    to_host_tree,
+)
+from sheeprl_tpu.checkpoint.writer import AsyncCheckpointWriter
+
+__all__ = [
+    "AsyncCheckpointWriter",
+    "CheckpointManager",
+    "KeyArrayRef",
+    "PREEMPTION_GUARD",
+    "PreemptionGuard",
+    "checkpoint_step",
+    "durable_write",
+    "from_host_tree",
+    "gc_checkpoints",
+    "install_preemption_handler",
+    "is_committed",
+    "latest_checkpoint",
+    "list_checkpoints",
+    "load_checkpoint",
+    "preemption_requested",
+    "read_manifest",
+    "resolve_auto_resume",
+    "save_checkpoint",
+    "snapshot_tree",
+    "to_host_tree",
+    "verify_checkpoint",
+]

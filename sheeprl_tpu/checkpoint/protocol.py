@@ -1,0 +1,240 @@
+"""Durable multi-rank commit protocol for snapshot directories.
+
+Layout of one snapshot (one per checkpointed policy step)::
+
+    <ckpt_root>/
+      step_000000001024/
+        shard_r00000.pkl        # rank 0's host state tree (pickle)
+        shard_r00000.meta.json  # {crc32, bytes} for that shard
+        shard_r00001.pkl
+        shard_r00001.meta.json
+        MANIFEST.json           # step, world size, per-shard crc32/bytes
+        COMMIT                  # empty marker, LAST write of the protocol
+
+Every write is tmp-file + fsync + rename + dir-fsync (serialize.durable_write),
+and the ``COMMIT`` marker lands only after rank 0 has observed every shard's
+meta file — so :func:`latest_checkpoint` (which only ever considers
+directories containing ``COMMIT``) can never select a torn snapshot, no
+matter where a preemption or power loss interrupts the sequence.
+
+Rank coordination is filesystem-based on purpose: shards are written by
+background threads (see ``writer.py``) where collective ops are off-limits
+(the fabric's collective sequence numbers assume lockstep main-thread
+calls), and TPU fleets checkpoint to shared storage anyway.  Rank 0 polls
+for the other ranks' meta files with a timeout; on timeout the snapshot is
+simply left uncommitted — invisible to resume, reclaimed by GC later.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+import zlib
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Union
+
+from sheeprl_tpu.checkpoint.serialize import (
+    dump_bytes,
+    durable_write,
+    from_host_tree,
+    fsync_dir,
+)
+
+COMMIT_FILE = "COMMIT"
+MANIFEST_FILE = "MANIFEST.json"
+STEP_PREFIX = "step_"
+
+
+def step_dir_name(step: int) -> str:
+    return f"{STEP_PREFIX}{int(step):012d}"
+
+
+def shard_name(rank: int) -> str:
+    return f"shard_r{int(rank):05d}.pkl"
+
+
+def _meta_name(rank: int) -> str:
+    return f"shard_r{int(rank):05d}.meta.json"
+
+
+def checkpoint_step(step_dir: Union[str, os.PathLike]) -> int:
+    """Policy step encoded in a snapshot directory name (-1 if not one)."""
+    name = Path(step_dir).name
+    if not name.startswith(STEP_PREFIX):
+        return -1
+    try:
+        return int(name[len(STEP_PREFIX):])
+    except ValueError:
+        return -1
+
+
+def write_shard(
+    step_dir: Union[str, os.PathLike], rank: int, host_state: Any
+) -> Dict[str, int]:
+    """Durably write one rank's shard + its meta sidecar.  The meta file is
+    written AFTER the shard, so its presence implies a complete shard."""
+    step_dir = Path(step_dir)
+    payload, crc = dump_bytes(host_state)
+    durable_write(step_dir / shard_name(rank), payload)
+    meta = {"crc32": crc, "bytes": len(payload)}
+    durable_write(step_dir / _meta_name(rank), json.dumps(meta).encode())
+    return meta
+
+
+def wait_for_shards(
+    step_dir: Union[str, os.PathLike], world: int, timeout_s: float = 300.0
+) -> Optional[Dict[str, Dict[str, int]]]:
+    """Poll until every rank's meta file exists; return {shard_name: meta}
+    or None on timeout (the snapshot then stays uncommitted)."""
+    step_dir = Path(step_dir)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        missing = [r for r in range(world) if not (step_dir / _meta_name(r)).exists()]
+        if not missing:
+            break
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(0.05)
+    shards: Dict[str, Dict[str, int]] = {}
+    for r in range(world):
+        with open(step_dir / _meta_name(r)) as f:
+            shards[shard_name(r)] = json.load(f)
+    return shards
+
+
+def write_commit(
+    step_dir: Union[str, os.PathLike],
+    step: int,
+    world: int,
+    timeout_s: float = 300.0,
+    extra: Optional[Dict[str, Any]] = None,
+) -> bool:
+    """Rank 0's side of the protocol: wait for all shards, write the CRC
+    manifest, then the ``COMMIT`` marker.  Returns False on shard timeout
+    (snapshot left uncommitted — never eligible for resume)."""
+    step_dir = Path(step_dir)
+    shards = wait_for_shards(step_dir, world, timeout_s)
+    if shards is None:
+        return False
+    manifest = {
+        "step": int(step),
+        "world": int(world),
+        "time": time.time(),
+        "shards": shards,
+    }
+    if extra:
+        manifest.update(extra)
+    durable_write(step_dir / MANIFEST_FILE, json.dumps(manifest, indent=1).encode())
+    durable_write(step_dir / COMMIT_FILE, b"")
+    return True
+
+
+def is_committed(step_dir: Union[str, os.PathLike]) -> bool:
+    return (Path(step_dir) / COMMIT_FILE).exists()
+
+
+def read_manifest(step_dir: Union[str, os.PathLike]) -> Dict[str, Any]:
+    with open(Path(step_dir) / MANIFEST_FILE) as f:
+        return json.load(f)
+
+
+def verify_checkpoint(step_dir: Union[str, os.PathLike]) -> List[str]:
+    """Re-read every shard and check its CRC against the manifest.  Returns
+    the list of problems (empty == intact)."""
+    step_dir = Path(step_dir)
+    problems: List[str] = []
+    if not is_committed(step_dir):
+        return [f"{step_dir}: no {COMMIT_FILE} marker"]
+    try:
+        manifest = read_manifest(step_dir)
+    except (OSError, json.JSONDecodeError) as e:
+        return [f"{step_dir}: unreadable manifest ({e})"]
+    for name, meta in manifest.get("shards", {}).items():
+        shard = step_dir / name
+        if not shard.exists():
+            problems.append(f"{name}: missing")
+            continue
+        data = shard.read_bytes()
+        if len(data) != meta["bytes"]:
+            problems.append(f"{name}: {len(data)} bytes, manifest says {meta['bytes']}")
+        elif (zlib.crc32(data) & 0xFFFFFFFF) != meta["crc32"]:
+            problems.append(f"{name}: CRC mismatch")
+    return problems
+
+
+def list_checkpoints(
+    root: Union[str, os.PathLike], committed_only: bool = True
+) -> List[Path]:
+    """Snapshot directories under ``root``, sorted by ascending step."""
+    root = Path(root)
+    if not root.is_dir():
+        return []
+    dirs = [d for d in root.iterdir() if d.is_dir() and checkpoint_step(d) >= 0]
+    if committed_only:
+        dirs = [d for d in dirs if is_committed(d)]
+    return sorted(dirs, key=checkpoint_step)
+
+
+def latest_checkpoint(root: Union[str, os.PathLike]) -> Optional[Path]:
+    """Newest COMMITTED snapshot under ``root`` (a ``<log_dir>/checkpoint``
+    directory), or None.  Uncommitted (torn) snapshots are never returned."""
+    ckpts = list_checkpoints(root, committed_only=True)
+    return ckpts[-1] if ckpts else None
+
+
+def load_step_dir(step_dir: Union[str, os.PathLike], rank: int = 0) -> Any:
+    """Load one rank's state from a committed snapshot directory.  Falls
+    back to shard 0 when this rank has no shard (e.g. resuming a 2-process
+    run single-process: replicated params/opt state live in every shard)."""
+    import pickle
+
+    step_dir = Path(step_dir)
+    if not is_committed(step_dir):
+        raise FileNotFoundError(
+            f"checkpoint {step_dir} has no {COMMIT_FILE} marker — it is a torn "
+            "snapshot (interrupted save) and cannot be resumed from"
+        )
+    shard = step_dir / shard_name(rank)
+    if not shard.exists():
+        shard = step_dir / shard_name(0)
+    with open(shard, "rb") as f:
+        return from_host_tree(pickle.load(f))
+
+
+def gc_checkpoints(
+    root: Union[str, os.PathLike],
+    keep_last: Optional[int],
+    keep_every: Optional[int] = None,
+) -> List[Path]:
+    """Retention: delete committed snapshots beyond the newest ``keep_last``,
+    except those whose step is a multiple of ``keep_every`` (policy steps) —
+    the keep-last-N + keep-every-K policy.  Uncommitted snapshots older than
+    the newest committed one are torn leftovers and are removed too.
+    Returns the deleted directories.  ``keep_last`` in (None, 0, -1) keeps
+    everything (GC fully disabled, including torn-snapshot cleanup)."""
+    root = Path(root)
+    if keep_last is None or keep_last <= 0:
+        return []
+    committed = list_checkpoints(root, committed_only=True)
+    victims = committed[:-keep_last] if keep_last else []
+    if keep_every and keep_every > 0:
+        victims = [d for d in victims if checkpoint_step(d) % keep_every != 0]
+    if committed:
+        newest = checkpoint_step(committed[-1])
+        victims += [
+            d
+            for d in list_checkpoints(root, committed_only=False)
+            if not is_committed(d) and checkpoint_step(d) < newest
+        ]
+    deleted = []
+    for d in victims:
+        try:
+            shutil.rmtree(d)
+            deleted.append(d)
+        except OSError:
+            pass
+    if deleted:
+        fsync_dir(root)
+    return deleted

@@ -1,0 +1,126 @@
+"""Background checkpoint writer.
+
+One daemon thread drains a BOUNDED queue of save jobs.  The split of work
+between threads is the point of the design:
+
+* **Caller thread** (the train loop): takes the point-in-time snapshot
+  (``serialize.snapshot_tree`` — on-device copies dispatched async, host
+  memcpys) and enqueues.  Cost: microseconds of dispatch + the host copy,
+  never a device sync.
+* **Writer thread**: fences the snapshot with ``utils.device_sync`` (the
+  PR-1 fence that is trustworthy on the axon tunnel where
+  ``block_until_ready`` resolves at dispatch), performs the blocking
+  ``jax.device_get``, pickles, CRCs, and writes durably — all overlapped
+  with the next update step on the main thread.
+
+The queue is bounded (default 2 in-flight snapshots): if training
+checkpoints faster than the disk drains, ``submit`` blocks — back-pressure
+instead of unbounded host-memory growth from queued device copies.
+
+A failed job parks its exception and re-raises on the NEXT ``submit`` /
+``flush`` so a dying disk cannot silently drop checkpoints for the rest of
+a run.  Save timing/bytes are reported into
+``utils.profiler.CHECKPOINT_MONITOR`` and surface as ``Checkpoint/*``
+metrics through ``utils.metric.flush_metrics``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from sheeprl_tpu.utils.profiler import CHECKPOINT_MONITOR
+
+
+class AsyncCheckpointWriter:
+    """Single background thread executing checkpoint save jobs in order."""
+
+    def __init__(self, queue_size: int = 2, name: str = "ckpt-writer"):
+        self._queue: "queue.Queue[Optional[Callable[[], Any]]]" = queue.Queue(
+            maxsize=max(1, int(queue_size))
+        )
+        self._error: Optional[BaseException] = None
+        self._idle = threading.Event()
+        self._idle.set()
+        # pending counter incremented BEFORE the queue put: relying on
+        # queue.unfinished_tasks alone leaves a window between idle.clear()
+        # and put() where the worker, finishing the previous job, would see
+        # zero unfinished tasks and re-set idle under a queued submit
+        self._pending = 0
+        self._pending_lock = threading.Lock()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
+        self._thread.start()
+
+    # -- worker --------------------------------------------------------------
+    def _loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                self._queue.task_done()
+                return
+            t0 = time.perf_counter()
+            try:
+                nbytes = job()
+                CHECKPOINT_MONITOR.record_save(
+                    seconds=time.perf_counter() - t0,
+                    nbytes=int(nbytes or 0),
+                    asynchronous=True,
+                )
+            except BaseException as e:  # parked, re-raised on next submit/flush
+                self._error = e
+                CHECKPOINT_MONITOR.record_error()
+            finally:
+                self._queue.task_done()
+                with self._pending_lock:
+                    self._pending -= 1
+                    if self._pending == 0:
+                        self._idle.set()
+
+    # -- API -----------------------------------------------------------------
+    @property
+    def in_flight(self) -> int:
+        return self._queue.unfinished_tasks
+
+    def _raise_pending(self) -> None:
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise RuntimeError("async checkpoint save failed") from err
+
+    def submit(self, job: Callable[[], Any]) -> None:
+        """Enqueue a save job (a callable returning the bytes written).
+        Blocks when the bounded queue is full (back-pressure)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointWriter is closed")
+        self._raise_pending()
+        with self._pending_lock:
+            self._pending += 1
+            self._idle.clear()
+        self._queue.put(job)
+        CHECKPOINT_MONITOR.record_depth(self.in_flight)
+
+    def flush(self, timeout_s: Optional[float] = None) -> bool:
+        """Wait until every queued job has finished.  Raises a parked writer
+        error; returns False only on timeout."""
+        done = self._idle.wait(timeout_s)
+        self._raise_pending()
+        return done
+
+    def close(self, timeout_s: Optional[float] = 300.0) -> None:
+        """Drain outstanding jobs and stop the thread (idempotent).  Must
+        return within ~``timeout_s`` even when the worker is wedged on a
+        dead disk: the sentinel put uses a timeout too — a full bounded
+        queue under a stuck worker would otherwise block forever, and the
+        daemon thread can simply be abandoned at process exit."""
+        if self._closed:
+            return
+        self._closed = True
+        drained = self._idle.wait(timeout_s)
+        try:
+            self._queue.put(None, timeout=5.0)
+        except queue.Full:
+            pass  # wedged worker + full queue: abandon the daemon thread
+        self._thread.join(timeout=timeout_s if drained else 5.0)
+        self._raise_pending()

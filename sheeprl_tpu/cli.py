@@ -132,24 +132,60 @@ def _maybe_register_models(fabric, cfg: dotdict) -> None:
     # ONLY the newest version dir — the one this run just wrote.  Falling
     # back to older runs would silently register stale weights when this
     # run saved no checkpoint (checkpoint.every=0, save_last=False).
-    ckpts = sorted(
-        glob.glob(os.path.join(versions[-1], "checkpoint", "*.ckpt")), key=os.path.getmtime
-    )
-    if not ckpts:
+    from sheeprl_tpu.checkpoint import latest_checkpoint
+
+    newest = latest_checkpoint(os.path.join(versions[-1], "checkpoint"))
+    if newest is None:
+        # legacy flat-file layout (fabric.save / old runs)
+        ckpts = sorted(
+            glob.glob(os.path.join(versions[-1], "checkpoint", "*.ckpt")), key=os.path.getmtime
+        )
+        newest = ckpts[-1] if ckpts else None
+    if newest is None:
         warnings.warn(
             "model_manager.disabled=False but the run saved no checkpoint; "
             "nothing registered", UserWarning
         )
         return
-    state = load_checkpoint(ckpts[-1])
+    state = load_checkpoint(newest)
     out = register_model_from_checkpoint(fabric, cfg, state)
     if out:
-        print(f"Registered models from {ckpts[-1]}: {out}")
+        print(f"Registered models from {newest}: {out}")
+
+
+def resolve_resume_target(cfg: dotdict) -> dotdict:
+    """Resolve ``checkpoint.resume_from=auto`` to the newest COMMITTED
+    snapshot across every run/version under this experiment's root
+    (``<log_dir>/<root_dir>``).  Torn snapshots (no COMMIT marker) are never
+    eligible.  No committed snapshot → start fresh, with a warning."""
+    if cfg.checkpoint.get("resume_from") != "auto":
+        return cfg
+    from sheeprl_tpu.checkpoint import resolve_auto_resume
+
+    target = resolve_auto_resume(cfg.get("log_dir", "logs/runs"), cfg.root_dir)
+    if target is None:
+        warnings.warn(
+            f"checkpoint.resume_from=auto: no committed checkpoint found under "
+            f"{os.path.join(str(cfg.get('log_dir', 'logs/runs')), str(cfg.root_dir))}; "
+            "starting fresh",
+            UserWarning,
+        )
+        cfg.checkpoint.resume_from = None
+    else:
+        print(f"checkpoint.resume_from=auto -> {target}")
+        cfg.checkpoint.resume_from = str(target)
+    return cfg
 
 
 def run(argv: Optional[List[str]] = None) -> None:
     argv = list(sys.argv[1:] if argv is None else argv)
+    # a preemption latched during a PREVIOUS run in this interpreter was
+    # honored by that run's final save; this run starts un-preempted
+    from sheeprl_tpu.checkpoint import PREEMPTION_GUARD
+
+    PREEMPTION_GUARD.clear_latch()
     cfg = compose(argv)
+    cfg = resolve_resume_target(cfg)
     if cfg.checkpoint.get("resume_from"):
         cfg = resume_from_checkpoint(cfg)
     import sheeprl_tpu

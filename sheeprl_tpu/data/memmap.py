@@ -140,4 +140,32 @@ class MemmapArray(np.lib.mixins.NDArrayOperatorsMixin):
 
     def __setstate__(self, state: dict) -> None:
         self.__dict__.update(state)
+        if not os.path.exists(self._filename):
+            # The pickle stream only carries a REFERENCE to the backing file;
+            # when a checkpoint moves hosts without its memmap_buffer dir the
+            # data is genuinely gone.  Rehydrate an owned, anonymous,
+            # zero-filled backing of the right geometry and say so clearly,
+            # instead of letting np.memmap raise a bare FileNotFoundError
+            # from deep inside unpickling (the caller would have no idea
+            # which buffer, file, or checkpoint key was at fault).
+            import tempfile
+            import warnings
+
+            missing = self._filename
+            fd, fresh = tempfile.mkstemp(suffix=".memmap")
+            os.close(fd)
+            warnings.warn(
+                f"MemmapArray backing file '{missing}' is missing (checkpoint "
+                "restored on a different host without its memmap_buffer "
+                "directory?): rehydrating shape "
+                f"{self._shape} {self._dtype} ZERO-FILLED in '{fresh}' — "
+                "buffer contents from before the move are lost",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            self._filename = fresh
+            self._owner = True
+            self._anonymous = True
+            self._array = np.memmap(fresh, dtype=self._dtype, mode="w+", shape=self._shape)
+            return
         self._array = np.memmap(self._filename, dtype=self._dtype, mode="r+", shape=self._shape)

@@ -137,6 +137,8 @@ class Fabric:
         self.precision = Precision.from_string(precision)
         self.callbacks: List[Any] = []
         self._callback_cfg = callbacks or {}
+        #: set by get_checkpoint_manager once a train loop binds its log_dir
+        self.checkpoint_manager: Optional[Any] = None
         ensure_compilation_cache()
 
         global _FORCED_CPU_PLATFORM
@@ -594,7 +596,21 @@ class Fabric:
                 fn(fabric=self, **kwargs)
 
     # -- persistence -------------------------------------------------------
+    def get_checkpoint_manager(self, cfg: Any, log_dir: Union[str, os.PathLike]) -> Any:
+        """The run's :class:`~sheeprl_tpu.checkpoint.CheckpointManager`,
+        created on first call (train loops bind it right after resolving
+        their ``log_dir``) and cached on the fabric so the checkpoint
+        callback can reach it through ``fabric.checkpoint_manager``."""
+        if self.checkpoint_manager is None:
+            from sheeprl_tpu.checkpoint import CheckpointManager
+
+            self.checkpoint_manager = CheckpointManager(self, cfg, log_dir)
+        return self.checkpoint_manager
+
     def save(self, path: Union[str, os.PathLike], state: Dict[str, Any]) -> None:
+        """Legacy single-file save (rank 0 only + barrier).  Train loops now
+        checkpoint through the manager/commit protocol instead; this remains
+        for tests, tools, and external callers."""
         from sheeprl_tpu.utils.checkpoint import save_checkpoint
 
         if self.is_global_zero:
@@ -602,9 +618,11 @@ class Fabric:
         self.barrier()
 
     def load(self, path: Union[str, os.PathLike]) -> Dict[str, Any]:
+        """Load a legacy ``.ckpt`` file or a committed snapshot directory
+        (this rank's shard, falling back to shard 0)."""
         from sheeprl_tpu.utils.checkpoint import load_checkpoint
 
-        return load_checkpoint(path)
+        return load_checkpoint(path, rank=self.global_rank)
 
     # -- misc ---------------------------------------------------------------
     def print(self, *args: Any, **kwargs: Any) -> None:
@@ -788,6 +806,11 @@ def build_fabric(cfg: Any) -> Fabric:
         from sheeprl_tpu.utils.callback import CheckpointCallback
 
         fabric.register_callback(CheckpointCallback(keep_last=cb_cfg["checkpoint"].get("keep_last", 5)))
+    # graceful preemption (SIGTERM/SIGINT latch) is armed by the FIRST
+    # CheckpointManager.should_save poll, not here: surfaces that never poll
+    # the latch (dedicated lockstep topologies, the evaluation CLI) must keep
+    # the default signal disposition — latching a signal nobody reads would
+    # swallow the preemption grace window entirely
     return fabric
 
 
@@ -823,6 +846,7 @@ def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
     sub.mesh = Mesh(np.asarray(trainer_devices), ("data",))
     sub.data_axis = "data"
     sub.tp_min_param_size = fabric.tp_min_param_size
+    sub.checkpoint_manager = fabric.checkpoint_manager
     return sub
 
 
@@ -841,6 +865,7 @@ def get_single_device_fabric(fabric: Fabric, device: Optional[Any] = None) -> Fa
     single.accelerator = fabric.accelerator
     single.mesh = Mesh(np.asarray([device]), ("data",))
     single.data_axis = "data"
+    single.checkpoint_manager = None
     return single
 
 

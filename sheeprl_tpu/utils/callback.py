@@ -40,16 +40,28 @@ class CheckpointCallback:
             with _consistent_tail(replay_buffer):
                 state = dict(state)
                 state["rb"] = _buffer_state(replay_buffer)
-                fabric.save(ckpt_path, state)
+                # with an async manager the state SNAPSHOT (host memcpys)
+                # happens inside save() on this thread, i.e. still under the
+                # tail patch — only serialization/IO runs in the background
+                self._save(fabric, ckpt_path, state)
         else:
-            fabric.save(ckpt_path, state)
-        if fabric.is_global_zero:
-            prune_checkpoints(Path(ckpt_path).parent, self.keep_last)
+            self._save(fabric, ckpt_path, state)
 
     def on_checkpoint_player(self, fabric: Any, ckpt_path: str, state: Dict[str, Any], replay_buffer: Any = None) -> None:
         self.on_checkpoint_coupled(fabric, ckpt_path, state, replay_buffer)
 
     def on_checkpoint_trainer(self, fabric: Any, ckpt_path: str, state: Dict[str, Any]) -> None:
+        self._save(fabric, ckpt_path, state)
+
+    # -- save routing --------------------------------------------------------
+    def _save(self, fabric: Any, ckpt_path: str, state: Dict[str, Any]) -> None:
+        """Route through the run's CheckpointManager (async snapshots, commit
+        protocol, retention — sheeprl_tpu/checkpoint) when the loop has bound
+        one; otherwise the legacy single-file path + flat-file pruning."""
+        manager = getattr(fabric, "checkpoint_manager", None)
+        if manager is not None:
+            manager.save(int(state.get("policy_step", 0)), state)
+            return
         fabric.save(ckpt_path, state)
         if fabric.is_global_zero:
             prune_checkpoints(Path(ckpt_path).parent, self.keep_last)

@@ -1,58 +1,35 @@
-"""Checkpoint serialization.
+"""Checkpoint serialization — compatibility shim.
 
-A checkpoint is a nested dict whose leaves may be jax arrays (fetched to
-host), numpy arrays, ``MemmapArray``s (pickled as references to their backing
-files — the reference persists buffers the same way,
-sheeprl/utils/memmap.py:251-258), and plain Python scalars/objects.
+The implementation moved to the fault-tolerant checkpointing subsystem
+(:mod:`sheeprl_tpu.checkpoint`, see docs/checkpointing.md): durable
+fsync'd atomic writes, typed-PRNG-key-safe host trees, the multi-rank
+commit protocol, async snapshots, preemption handling and retention all
+live there.  This module keeps the original import surface:
 
-Format: a single pickle stream with jax arrays converted to numpy.  The save
-is atomic (tmp file + rename) so a preempted TPU job never leaves a torn
-checkpoint behind.
+* :func:`save_checkpoint` — single-file durable pickle (``fabric.save``).
+* :func:`load_checkpoint` — loads a legacy ``.ckpt`` file or a committed
+  snapshot directory.
+* :func:`prune_checkpoints` — legacy flat ``ckpt_*.ckpt`` retention; new
+  snapshot directories are retained by
+  :func:`sheeprl_tpu.checkpoint.gc_checkpoints`.
 """
 
 from __future__ import annotations
 
 import os
-import pickle
-import tempfile
 from pathlib import Path
-from typing import Any, Dict, Union
+from typing import Union
 
-import jax
-import numpy as np
-
-
-def _to_host(tree: Any) -> Any:
-    def leaf(x: Any) -> Any:
-        if isinstance(x, jax.Array):
-            return np.asarray(jax.device_get(x))
-        return x
-
-    return jax.tree.map(leaf, tree, is_leaf=lambda x: isinstance(x, jax.Array))
-
-
-def save_checkpoint(path: Union[str, os.PathLike], state: Dict[str, Any]) -> None:
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
-    host_state = _to_host(state)
-    fd, tmp = tempfile.mkstemp(dir=path.parent, suffix=".tmp")
-    try:
-        with os.fdopen(fd, "wb") as f:
-            pickle.dump(host_state, f, protocol=pickle.HIGHEST_PROTOCOL)
-        os.replace(tmp, path)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
-
-
-def load_checkpoint(path: Union[str, os.PathLike]) -> Dict[str, Any]:
-    with open(path, "rb") as f:
-        return pickle.load(f)
+from sheeprl_tpu.checkpoint.serialize import (  # noqa: F401  (re-exports)
+    load_checkpoint,
+    save_checkpoint,
+)
+from sheeprl_tpu.checkpoint.protocol import latest_checkpoint  # noqa: F401
 
 
 def prune_checkpoints(ckpt_dir: Union[str, os.PathLike], keep_last: int) -> None:
-    """Delete all but the newest ``keep_last`` checkpoints in a directory
-    (reference: sheeprl/utils/callback.py:144-148)."""
+    """Delete all but the newest ``keep_last`` legacy flat-file checkpoints
+    in a directory (reference: sheeprl/utils/callback.py:144-148)."""
     if keep_last is None or keep_last <= 0:
         return
     ckpts = sorted(Path(ckpt_dir).glob("ckpt_*.ckpt"), key=lambda p: p.stat().st_mtime)

@@ -59,6 +59,54 @@ def rmsprop_tf(
     return optax.chain(base, optax.scale_by_learning_rate(learning_rate, flip_sign=False))
 
 
+#: optax >= 0.2.4 exposes eps placement on optax.rmsprop; 0.2.3 does not.
+import inspect as _inspect
+
+_OPTAX_RMSPROP_HAS_EPS_IN_SQRT = (
+    "eps_in_sqrt" in _inspect.signature(optax.rmsprop).parameters
+)
+
+
+def rmsprop_torch(
+    learning_rate: Any,
+    decay: float = 0.99,
+    eps: float = 1e-8,
+    momentum: float = 0.0,
+    centered: bool = False,
+) -> optax.GradientTransformation:
+    """Torch-flavored RMSprop for optax builds without ``eps_in_sqrt``:
+    square-average initialized to ZEROS and ``eps`` added OUTSIDE the sqrt —
+    ``g / (sqrt(nu) + eps)`` — matching ``torch.optim.RMSprop`` (and
+    ``optax.rmsprop(eps_in_sqrt=False)`` on newer optax)."""
+
+    def init_fn(params):
+        nu = jax.tree.map(jnp.zeros_like, params)
+        mom = jax.tree.map(jnp.zeros_like, params) if momentum > 0 else None
+        mg = jax.tree.map(jnp.zeros_like, params) if centered else None
+        return {"nu": nu, "mom": mom, "mg": mg}
+
+    def update_fn(updates, state, params=None):
+        nu = jax.tree.map(lambda n, g: decay * n + (1 - decay) * g * g, state["nu"], updates)
+        if centered:
+            mg = jax.tree.map(lambda m, g: decay * m + (1 - decay) * g, state["mg"], updates)
+            denom = jax.tree.map(lambda n, m: jnp.sqrt(n - m * m) + eps, nu, mg)
+        else:
+            mg = None
+            denom = jax.tree.map(lambda n: jnp.sqrt(n) + eps, nu)
+        scaled = jax.tree.map(lambda g, d: g / d, updates, denom)
+        if momentum > 0:
+            mom = jax.tree.map(lambda b, s: momentum * b + s, state["mom"], scaled)
+            out = mom
+        else:
+            mom = None
+            out = scaled
+        out = jax.tree.map(lambda u: -u, out)
+        return out, {"nu": nu, "mom": mom, "mg": mg}
+
+    base = optax.GradientTransformation(init_fn, update_fn)
+    return optax.chain(base, optax.scale_by_learning_rate(learning_rate, flip_sign=False))
+
+
 def build_optimizer(
     optim_cfg: Any,
     max_grad_norm: Optional[float] = None,
@@ -85,18 +133,33 @@ def build_optimizer(
         )
     elif name == "rmsprop":
         momentum = float(optim_cfg.get("momentum", 0.0))
-        base = optax.inject_hyperparams(optax.rmsprop)(
-            learning_rate=lr,
-            decay=float(optim_cfg.get("alpha", 0.99)),
-            eps=float(optim_cfg.get("eps", 1e-8)),
-            # torch semantics: eps OUTSIDE the sqrt (the TF-style variant is
-            # the separate rmsprop_tf above)
-            eps_in_sqrt=False,
-            momentum=momentum if momentum > 0 else None,
-            centered=bool(optim_cfg.get("centered", False)),
-        )
+        if _OPTAX_RMSPROP_HAS_EPS_IN_SQRT:
+            base = optax.inject_hyperparams(optax.rmsprop)(
+                learning_rate=lr,
+                decay=float(optim_cfg.get("alpha", 0.99)),
+                eps=float(optim_cfg.get("eps", 1e-8)),
+                # torch semantics: eps OUTSIDE the sqrt (the TF-style variant
+                # is the separate rmsprop_tf above)
+                eps_in_sqrt=False,
+                momentum=momentum if momentum > 0 else None,
+                centered=bool(optim_cfg.get("centered", False)),
+            )
+        else:
+            # optax <= 0.2.3: optax.rmsprop has no eps_in_sqrt knob and its
+            # scale_by_rms puts eps INSIDE the sqrt — use the local
+            # torch-semantics implementation instead of TypeError-ing
+            # momentum is static: it selects the transform's STRUCTURE
+            # (whether a momentum buffer exists), so it cannot be traced
+            base = optax.inject_hyperparams(rmsprop_torch, static_args=("momentum",))(
+                learning_rate=lr,
+                decay=float(optim_cfg.get("alpha", 0.99)),
+                eps=float(optim_cfg.get("eps", 1e-8)),
+                momentum=momentum,
+                centered=bool(optim_cfg.get("centered", False)),
+            )
     elif name == "rmsprop_tf":
-        base = optax.inject_hyperparams(rmsprop_tf)(
+        # momentum static for the same structural reason as rmsprop_torch
+        base = optax.inject_hyperparams(rmsprop_tf, static_args=("momentum",))(
             learning_rate=lr,
             decay=float(optim_cfg.get("alpha", 0.9)),
             eps=float(optim_cfg.get("eps", 1e-10)),

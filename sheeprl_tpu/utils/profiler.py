@@ -155,6 +155,75 @@ class CompileMonitor:
 COMPILE_MONITOR = CompileMonitor()
 
 
+class CheckpointMonitor:
+    """Process-global accounting for the checkpointing subsystem
+    (``sheeprl_tpu.checkpoint``) — the same pattern as
+    :class:`CompileMonitor`: writer threads record, ``metric.flush_metrics``
+    surfaces the counters as ``Checkpoint/*`` without the loops threading a
+    handle through."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._saves = 0
+            self._async_saves = 0
+            self._errors = 0
+            self._bytes_total = 0
+            self._seconds_total = 0.0
+            self._last_seconds = 0.0
+            self._last_bytes = 0
+            self._max_depth = 0
+
+    def record_save(self, seconds: float, nbytes: int, asynchronous: bool) -> None:
+        with self._lock:
+            self._saves += 1
+            self._async_saves += 1 if asynchronous else 0
+            self._bytes_total += int(nbytes)
+            self._seconds_total += float(seconds)
+            self._last_seconds = float(seconds)
+            self._last_bytes = int(nbytes)
+
+    def record_error(self) -> None:
+        with self._lock:
+            self._errors += 1
+
+    def record_depth(self, depth: int) -> None:
+        with self._lock:
+            self._max_depth = max(self._max_depth, int(depth))
+
+    def metrics(self) -> Dict[str, float]:
+        """``Checkpoint/save_s`` is the LAST save's wall time — for async
+        saves that is writer-thread time overlapped with training, i.e. the
+        cost a synchronous save would have put on the critical path."""
+        with self._lock:
+            if self._saves == 0:
+                return {}
+            return {
+                "Checkpoint/save_s": round(self._last_seconds, 4),
+                "Checkpoint/bytes": float(self._last_bytes),
+                "Checkpoint/total_saves": float(self._saves),
+                "Checkpoint/total_bytes": float(self._bytes_total),
+                "Checkpoint/queue_depth_max": float(self._max_depth),
+            }
+
+    def totals(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "saves": self._saves,
+                "async_saves": self._async_saves,
+                "errors": self._errors,
+                "bytes": self._bytes_total,
+                "seconds": round(self._seconds_total, 4),
+            }
+
+
+#: The process-global monitor the checkpoint writer reports into.
+CHECKPOINT_MONITOR = CheckpointMonitor()
+
+
 class ProfilerGate:
     """Start/stop ``jax.profiler`` around a window of training updates."""
 

@@ -465,24 +465,25 @@ def device_sync(tree: Any = None) -> None:
     transfer (~65 ms over the tunnel, ~µs on local backends).
 
     On backends whose ``block_until_ready`` IS trustworthy (cpu / gpu /
-    directly-attached tpu), the drain-everything form (``tree is None``)
-    uses it directly: building token ops for thousands of live arrays
-    would cost more than the fence is worth there.
+    directly-attached tpu), BOTH forms use it directly: the token program
+    is O(leaves) to build and compile, and a fresh state-tree signature
+    (every checkpoint save in every test process) would pay a multi-second
+    XLA compile for a guarantee block_until_ready already provides there.
     """
     if tree is None:
         leaves = list(jax.live_arrays())
-        if not _untrusted_block_until_ready():
-            for a in leaves:
-                # donated inputs may linger as deleted buffers — skip, and
-                # keep draining the rest if any single array refuses
-                try:
-                    if not a.is_deleted():
-                        a.block_until_ready()
-                except Exception:
-                    continue
-            return
     else:
         leaves = jax.tree_util.tree_leaves(tree)
+    if not _untrusted_block_until_ready():
+        for a in leaves:
+            # donated inputs may linger as deleted buffers — skip, and
+            # keep draining the rest if any single array refuses
+            try:
+                if isinstance(a, jax.Array) and not a.is_deleted():
+                    a.block_until_ready()
+            except Exception:
+                continue
+        return
     groups: Dict[Any, list] = {}
     for leaf in leaves:
         if not isinstance(leaf, jax.Array):

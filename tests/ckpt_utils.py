@@ -1,0 +1,19 @@
+"""Shared helper for locating checkpoints written by the fault-tolerant
+checkpointing subsystem (committed ``step_*`` snapshot directories) with a
+fallback to the legacy flat ``ckpt_*.ckpt`` layout."""
+
+import glob
+
+
+def find_checkpoints(root):
+    """All COMMITTED snapshot directories (plus any legacy flat-file
+    checkpoints) under ``root``, oldest → newest."""
+    from sheeprl_tpu.checkpoint import list_checkpoints
+
+    out = []
+    for ckpt_root in glob.glob(f"{root}/**/checkpoint", recursive=True):
+        out.extend(str(p) for p in list_checkpoints(ckpt_root))
+    out.extend(glob.glob(f"{root}/**/ckpt_*.ckpt", recursive=True))
+    import os
+
+    return sorted(out, key=os.path.getmtime)

@@ -8,13 +8,16 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/3: unit + E2E dry-run suite ==="
-python -m pytest tests/ -x -q --ignore=tests/test_regression
+echo "=== stage 1/4: unit + E2E dry-run suite ==="
+python -m pytest tests/ -x -q --ignore=tests/test_regression --ignore=tests/test_checkpoint
 
-echo "=== stage 2/3: numeric regression (goldens + reference fixture) ==="
+echo "=== stage 2/4: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
+python -m pytest tests/test_checkpoint -q
+
+echo "=== stage 3/4: numeric regression (goldens + reference fixture) ==="
 python -m pytest tests/test_regression -q
 
-echo "=== stage 3/3: multichip dryrun (virtual 8-device mesh) ==="
+echo "=== stage 4/4: multichip dryrun (virtual 8-device mesh) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
 echo "CI gate: ALL GREEN"

@@ -11,6 +11,7 @@ from unittest import mock
 import pytest
 
 from sheeprl_tpu.cli import run
+from tests.ckpt_utils import find_checkpoints
 
 
 def standard_args(tmp_path, extra=(), devices=1):
@@ -86,7 +87,7 @@ def test_ppo_dry_run(tmp_path, devices, env_id):
     # a checkpoint must exist
     import glob
 
-    assert glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert find_checkpoints(f"{tmp_path}/logs")
 
 
 def test_ppo_pixel_encoder(tmp_path):
@@ -126,7 +127,7 @@ def test_ppo_resume_from_checkpoint(tmp_path):
     run(args)
     import glob
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     run(args + [f"checkpoint.resume_from={ckpts[0]}"])
 
@@ -159,7 +160,7 @@ def test_evaluation_cli(tmp_path, monkeypatch):
 
     from sheeprl_tpu.cli import evaluation
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     evaluation([f"checkpoint_path={ckpts[0]}", "env.capture_video=False"])
 
 
@@ -183,7 +184,7 @@ def test_evaluation_cli_after_dreamer(tmp_path, monkeypatch):
 
     from sheeprl_tpu.cli import evaluation
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     evaluation([f"checkpoint_path={ckpts[0]}", "env.capture_video=False"])
 
@@ -408,7 +409,7 @@ def test_p2e_dv3_exploration_and_finetuning(tmp_path):
     run(args)
     import glob
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     ft_args = standard_args(
         tmp_path,
@@ -454,7 +455,7 @@ def test_p2e_dv12_exploration_and_finetuning(tmp_path, version):
     run(args)
     import glob
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     run(
         standard_args(
@@ -518,7 +519,7 @@ def test_dreamer_v3_resume_from_checkpoint(tmp_path):
     run(args)
     import glob
 
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     # resume restores params/opt/counters/ratio and the replay buffer
     run(args + [f"checkpoint.resume_from={ckpts[0]}"])
@@ -573,7 +574,7 @@ def test_dreamer_v3_remat(tmp_path):
     run(args)
     import glob
 
-    assert glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    assert find_checkpoints(f"{tmp_path}/logs")
 
 
 def test_profiler_gate_captures_trace(tmp_path):
@@ -673,6 +674,6 @@ def test_evaluation_cli_after_decoupled(tmp_logdir, exp, extra):
 
     from sheeprl_tpu.cli import evaluation
 
-    ckpts = glob.glob(f"{tmp_logdir}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_logdir}/logs")
     assert ckpts
     evaluation([f"checkpoint_path={ckpts[0]}", "env.capture_video=False"])

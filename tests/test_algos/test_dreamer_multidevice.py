@@ -9,6 +9,7 @@ import glob
 import numpy as np
 
 from sheeprl_tpu.cli import run
+from tests.ckpt_utils import find_checkpoints
 from tests.test_algos.test_algos import TINY_DV3_ARGS, standard_args
 
 
@@ -25,7 +26,7 @@ def test_dreamer_v3_two_devices_with_resume(tmp_path):
         devices=2,
     )
     run(args)
-    ckpts = glob.glob(f"{tmp_path}/logs/**/ckpt_*.ckpt", recursive=True)
+    ckpts = find_checkpoints(f"{tmp_path}/logs")
     assert ckpts
     # resume the 2-device run from its own mesh-saved checkpoint
     run(args + [f"checkpoint.resume_from={sorted(ckpts)[-1]}"])

@@ -1,0 +1,356 @@
+"""Unit tests for the fault-tolerant checkpointing subsystem
+(sheeprl_tpu/checkpoint/): serialization fidelity (bit-exact round trips,
+typed PRNG keys), the durable commit protocol (torn snapshots never
+resumable, CRC verification), retention GC, the async writer, preemption
+latch, and auto-resume discovery."""
+
+import json
+import os
+import pickle
+import signal
+import threading
+import time
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from sheeprl_tpu.checkpoint import (
+    AsyncCheckpointWriter,
+    CheckpointManager,
+    PREEMPTION_GUARD,
+    gc_checkpoints,
+    latest_checkpoint,
+    list_checkpoints,
+    load_checkpoint,
+    resolve_auto_resume,
+    save_checkpoint,
+    verify_checkpoint,
+)
+from sheeprl_tpu.checkpoint.protocol import (
+    checkpoint_step,
+    is_committed,
+    load_step_dir,
+    step_dir_name,
+    write_commit,
+    write_shard,
+)
+from sheeprl_tpu.checkpoint.serialize import from_host_tree, to_host_tree
+from sheeprl_tpu.utils.structured import dotdict
+
+
+class _FakeFabric:
+    global_rank = 0
+    num_processes = 1
+
+    def barrier(self):
+        pass
+
+
+def _cfg(**overrides):
+    base = {
+        "checkpoint": {
+            "every": 1,
+            "save_last": True,
+            "keep_last": 5,
+            "keep_every": None,
+            "async_save": True,
+            "queue_size": 2,
+            "commit_timeout_s": 10.0,
+        }
+    }
+    base["checkpoint"].update(overrides)
+    return dotdict(base)
+
+
+def _rich_state():
+    """A state tree exercising every leaf kind the loops checkpoint: jax
+    params, an optax opt state, raw uint32 PRNG keys, typed (extended-dtype)
+    PRNG keys, numpy buffers, and plain counters."""
+    params = {"w": jnp.arange(6.0).reshape(2, 3), "b": jnp.ones(3)}
+    opt = optax.adam(1e-3)
+    return {
+        "agent": params,
+        "opt_state": opt.init(params),
+        "key": jax.random.PRNGKey(7),
+        "typed_key": jax.random.key(11),
+        "rb": {"buffer": {"obs": np.arange(12, dtype=np.float32).reshape(4, 3)}, "pos": 3},
+        "update": 17,
+        "policy_step": 340,
+    }
+
+
+def _assert_tree_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ---------------------------------------------------------------------------
+# serialization
+# ---------------------------------------------------------------------------
+def test_single_file_roundtrip_bit_exact(tmp_path):
+    state = _rich_state()
+    save_checkpoint(tmp_path / "c.ckpt", state)
+    loaded = load_checkpoint(tmp_path / "c.ckpt")
+    # typed PRNG keys must come back as typed keys producing identical streams
+    assert jnp.issubdtype(loaded["typed_key"].dtype, jax.dtypes.extended)
+    assert jax.random.uniform(loaded["typed_key"]) == jax.random.uniform(state["typed_key"])
+    loaded["typed_key"] = jax.random.key_data(loaded["typed_key"])
+    state = dict(state)
+    state["typed_key"] = jax.random.key_data(state["typed_key"])
+    _assert_tree_equal(state, loaded)
+
+
+def test_host_tree_roundtrip_typed_keys():
+    k = jax.random.key(3)
+    host = to_host_tree({"k": k})
+    # picklable without jax arrays in the stream
+    pickle.dumps(host)
+    back = from_host_tree(host)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(back["k"])), np.asarray(jax.random.key_data(k))
+    )
+
+
+def test_memmap_missing_backing_file_rehydrates_with_warning(tmp_path):
+    from sheeprl_tpu.data.memmap import MemmapArray
+
+    arr = MemmapArray.from_array(np.ones((2, 2), np.float32), filename=tmp_path / "m.memmap")
+    blob = pickle.dumps(arr)
+    arr.close(delete_file=True)
+    assert not os.path.exists(tmp_path / "m.memmap")
+    with pytest.warns(RuntimeWarning, match="backing file.*missing"):
+        back = pickle.loads(blob)
+    assert back.shape == (2, 2)
+    np.testing.assert_array_equal(np.asarray(back), np.zeros((2, 2), np.float32))
+
+
+def test_durable_write_leaves_no_tmp(tmp_path):
+    from sheeprl_tpu.checkpoint import durable_write
+
+    durable_write(tmp_path / "f.bin", b"payload")
+    assert (tmp_path / "f.bin").read_bytes() == b"payload"
+    assert [p.name for p in tmp_path.iterdir()] == ["f.bin"]
+
+
+# ---------------------------------------------------------------------------
+# commit protocol
+# ---------------------------------------------------------------------------
+def test_torn_snapshot_never_selected(tmp_path):
+    committed = tmp_path / step_dir_name(100)
+    committed.mkdir()
+    write_shard(committed, 0, {"update": 1})
+    assert write_commit(committed, step=100, world=1)
+    # a NEWER but interrupted (uncommitted) snapshot: shard written, no COMMIT
+    torn = tmp_path / step_dir_name(200)
+    torn.mkdir()
+    write_shard(torn, 0, {"update": 2})
+    assert latest_checkpoint(tmp_path) == committed
+    with pytest.raises(FileNotFoundError, match="torn"):
+        load_step_dir(torn)
+    assert load_step_dir(committed)["update"] == 1
+
+
+def test_commit_times_out_without_all_shards(tmp_path):
+    d = tmp_path / step_dir_name(10)
+    d.mkdir()
+    write_shard(d, 0, {"x": 1})
+    # world=2 but rank 1 never lands its shard
+    assert not write_commit(d, step=10, world=2, timeout_s=0.2)
+    assert not is_committed(d)
+
+
+def test_verify_checkpoint_detects_corruption(tmp_path):
+    d = tmp_path / step_dir_name(5)
+    d.mkdir()
+    write_shard(d, 0, {"x": np.arange(10)})
+    write_commit(d, step=5, world=1)
+    assert verify_checkpoint(d) == []
+    shard = next(d.glob("shard_*.pkl"))
+    data = bytearray(shard.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    shard.write_bytes(bytes(data))
+    problems = verify_checkpoint(d)
+    assert problems and "CRC mismatch" in problems[0]
+
+
+def test_multi_rank_shard_loading_falls_back(tmp_path):
+    d = tmp_path / step_dir_name(8)
+    d.mkdir()
+    write_shard(d, 0, {"rank": 0})
+    write_shard(d, 1, {"rank": 1})
+    write_commit(d, step=8, world=2)
+    assert load_step_dir(d, rank=1)["rank"] == 1
+    # resuming with MORE ranks than saved: falls back to shard 0
+    assert load_step_dir(d, rank=3)["rank"] == 0
+
+
+def test_retention_keep_last_plus_keep_every(tmp_path):
+    for step in (10, 20, 30, 40, 50):
+        d = tmp_path / step_dir_name(step)
+        d.mkdir()
+        write_shard(d, 0, {"s": step})
+        write_commit(d, step=step, world=1)
+    deleted = gc_checkpoints(tmp_path, keep_last=2, keep_every=20)
+    kept = sorted(checkpoint_step(d) for d in list_checkpoints(tmp_path))
+    # keep_last=2 -> {40, 50}; keep_every=20 rescues 20 (and 40, already kept)
+    assert kept == [20, 40, 50]
+    assert sorted(checkpoint_step(d) for d in deleted) == [10, 30]
+
+
+def test_retention_removes_stale_torn_snapshots(tmp_path):
+    for step in (10, 20):
+        d = tmp_path / step_dir_name(step)
+        d.mkdir()
+        write_shard(d, 0, {"s": step})
+        write_commit(d, step=step, world=1)
+    torn = tmp_path / step_dir_name(15)
+    torn.mkdir()
+    write_shard(torn, 0, {"s": 15})
+    gc_checkpoints(tmp_path, keep_last=2)
+    assert not torn.exists()
+    assert len(list_checkpoints(tmp_path)) == 2
+
+
+# ---------------------------------------------------------------------------
+# async writer
+# ---------------------------------------------------------------------------
+def test_async_writer_executes_jobs_and_flushes():
+    w = AsyncCheckpointWriter(queue_size=2)
+    done = []
+    for i in range(4):
+        w.submit(lambda i=i: done.append(i) or 10)
+    assert w.flush(timeout_s=10)
+    assert done == [0, 1, 2, 3]
+    w.close()
+
+
+def test_async_writer_propagates_errors_on_next_use():
+    def boom():
+        raise OSError("disk full")
+
+    w = AsyncCheckpointWriter(queue_size=1)
+    w.submit(boom)
+    with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+        w.flush(timeout_s=10)
+    # the error is delivered once; the writer keeps working afterwards
+    w.submit(lambda: 0)
+    assert w.flush(timeout_s=10)
+    w.close()
+
+
+def test_async_writer_backpressure_bounds_queue():
+    gate = threading.Event()
+    w = AsyncCheckpointWriter(queue_size=1)
+    w.submit(lambda: gate.wait(10) and 0)
+    t0 = time.monotonic()
+
+    def release():
+        time.sleep(0.3)
+        gate.set()
+
+    threading.Thread(target=release, daemon=True).start()
+    w.submit(lambda: 0)  # queued behind the gated job
+    w.submit(lambda: 0)  # must BLOCK until the gate opens
+    assert time.monotonic() - t0 >= 0.2
+    w.close()
+
+
+# ---------------------------------------------------------------------------
+# manager
+# ---------------------------------------------------------------------------
+def test_manager_async_save_commits_and_roundtrips(tmp_path):
+    mgr = CheckpointManager(_FakeFabric(), _cfg(), tmp_path)
+    state = _rich_state()
+    mgr.save(340, state)
+    mgr.finalize()
+    newest = latest_checkpoint(tmp_path / "checkpoint")
+    assert newest is not None and checkpoint_step(newest) == 340
+    assert verify_checkpoint(newest) == []
+    loaded = load_checkpoint(newest)
+    assert loaded["update"] == 17
+    np.testing.assert_array_equal(np.asarray(loaded["key"]), np.asarray(state["key"]))
+    _assert_tree_equal(loaded["agent"], state["agent"])
+    _assert_tree_equal(loaded["opt_state"], state["opt_state"])
+    assert loaded["rb"]["pos"] == 3
+
+
+def test_manager_snapshot_isolates_mutating_host_state(tmp_path):
+    """The snapshot must capture save-time contents even though the train
+    loop keeps writing into the same buffers while the writer serializes."""
+    mgr = CheckpointManager(_FakeFabric(), _cfg(), tmp_path)
+    buf = np.zeros(8, np.float32)
+    mgr.save(1, {"rb": {"buffer": buf}, "policy_step": 1})
+    buf[:] = 999.0  # the env loop keeps mutating after submit
+    mgr.finalize()
+    loaded = load_checkpoint(latest_checkpoint(tmp_path / "checkpoint"))
+    np.testing.assert_array_equal(loaded["rb"]["buffer"], np.zeros(8, np.float32))
+
+
+def test_manager_cadence_and_retention(tmp_path):
+    mgr = CheckpointManager(_FakeFabric(), _cfg(every=100, keep_last=2), tmp_path)
+    assert not mgr.should_save(policy_step=50, last_checkpoint=0)
+    assert mgr.should_save(policy_step=100, last_checkpoint=0)
+    assert mgr.should_save(policy_step=50, last_checkpoint=0, final=True)  # save_last
+    for step in (100, 200, 300):
+        mgr.save(step, {"policy_step": step})
+    mgr.finalize()
+    kept = [checkpoint_step(d) for d in list_checkpoints(tmp_path / "checkpoint")]
+    assert kept == [200, 300]
+
+
+def test_manager_sync_save_records_metrics(tmp_path):
+    from sheeprl_tpu.utils.profiler import CHECKPOINT_MONITOR
+
+    CHECKPOINT_MONITOR.reset()
+    mgr = CheckpointManager(_FakeFabric(), _cfg(async_save=False), tmp_path)
+    mgr.save(10, {"policy_step": 10, "blob": np.ones(1000, np.float32)})
+    m = CHECKPOINT_MONITOR.metrics()
+    assert m["Checkpoint/total_saves"] == 1.0
+    assert m["Checkpoint/bytes"] > 1000
+    assert is_committed(mgr.step_dir(10))
+
+
+# ---------------------------------------------------------------------------
+# preemption + auto-resume
+# ---------------------------------------------------------------------------
+def test_preemption_guard_latches_and_manager_goes_sync(tmp_path):
+    try:
+        assert PREEMPTION_GUARD.install()
+        assert not PREEMPTION_GUARD.requested()
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):
+            if PREEMPTION_GUARD.requested():
+                break
+            time.sleep(0.01)
+        assert PREEMPTION_GUARD.requested()
+        assert PREEMPTION_GUARD.signal_name == "SIGTERM"
+        mgr = CheckpointManager(_FakeFabric(), _cfg(every=10**9), tmp_path)
+        # preemption overrides cadence AND forces the synchronous path
+        assert mgr.should_save(policy_step=1, last_checkpoint=0)
+        mgr.save(1, {"policy_step": 1})
+        assert is_committed(mgr.step_dir(1))  # no finalize needed: sync
+    finally:
+        PREEMPTION_GUARD.reset()
+
+
+def test_resolve_auto_resume_scans_runs_and_skips_torn(tmp_path):
+    base, root_dir = tmp_path / "logs", "exp/env"
+    runs = base / root_dir
+    a = runs / "run_a" / "version_0" / "checkpoint" / step_dir_name(100)
+    b = runs / "run_b" / "version_0" / "checkpoint" / step_dir_name(50)
+    torn = runs / "run_b" / "version_0" / "checkpoint" / step_dir_name(999)
+    for d in (a, b, torn):
+        d.mkdir(parents=True)
+        write_shard(d, 0, {"s": 1})
+    write_commit(a, step=100, world=1)
+    time.sleep(0.02)
+    write_commit(b, step=50, world=1)  # newest COMMIT wins, even at lower step
+    assert resolve_auto_resume(base, root_dir) == b
+    assert resolve_auto_resume(base, "nothing/here") is None

@@ -131,8 +131,14 @@ _WORKER = textwrap.dedent(
     ])
     rank = jax.process_index()
     if rank == 0:
-        ckpts = glob.glob(f"{log_dir}/**/ckpt_*.ckpt", recursive=True)
-        assert ckpts, "rank 0 wrote no checkpoint"
+        from sheeprl_tpu.checkpoint import list_checkpoints
+
+        ckpts = [
+            c
+            for root in glob.glob(f"{log_dir}/**/checkpoint", recursive=True)
+            for c in list_checkpoints(root)
+        ]
+        assert ckpts, "rank 0 committed no checkpoint"
     print(f"rank {rank} TRAIN OK")
     """
 )
@@ -199,11 +205,10 @@ def _run_distributed(tmp_path, algo_args, nproc=2, batch=4, subdir="logs", timeo
 
 
 def _final_agent_params(log_dir):
-    import glob
-
     from sheeprl_tpu.utils.checkpoint import load_checkpoint
+    from tests.ckpt_utils import find_checkpoints
 
-    ckpts = sorted(glob.glob(f"{log_dir}/**/ckpt_*.ckpt", recursive=True))
+    ckpts = find_checkpoints(log_dir)
     assert ckpts, f"no checkpoint under {log_dir}"
     return load_checkpoint(ckpts[-1])["agent"]
 
@@ -270,8 +275,9 @@ def test_dedicated_five_process_four_trainers(tmp_path):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
 
     from sheeprl_tpu.cli import evaluation
+    from tests.ckpt_utils import find_checkpoints
 
-    ckpts = sorted(glob.glob(f"{dir_4t}/**/ckpt_*.ckpt", recursive=True))
+    ckpts = find_checkpoints(dir_4t)
     evaluation(
         [
             f"checkpoint_path={ckpts[-1]}",
