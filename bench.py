@@ -258,8 +258,120 @@ def bench_cpu_wall_clock(algo: str) -> dict:
     }
 
 
+def bench_serve() -> dict:
+    """Policy-as-a-service load benchmark (``--mode serve``).
+
+    Stands up a :class:`~sheeprl_tpu.serve.service.PolicyService` on a
+    committed checkpoint (``BENCH_SERVE_CKPT``, or a fresh tiny dryrun of
+    ``BENCH_SERVE_ALGO``, default ppo), then ``BENCH_SERVE_CLIENTS``
+    threads each stream ``BENCH_SERVE_REQUESTS`` blocking act() calls
+    through the continuous batcher.  Reports steady-state **actions/s**
+    plus the latency percentiles (p50/p99 ms) and the compile counters —
+    ``steady_compiles`` must be 0: the batch ladder is AOT-warmed before
+    the timed window, so a nonzero value means a shape escaped the ladder.
+    """
+    import tempfile
+    import threading
+
+    import numpy as np
+
+    algo = os.environ.get("BENCH_SERVE_ALGO", "ppo")
+    ckpt = os.environ.get("BENCH_SERVE_CKPT")
+    if not ckpt:
+        from sheeprl_tpu.cli import run
+        from tests.ckpt_utils import find_checkpoints
+
+        log_dir = tempfile.mkdtemp(prefix="bench_serve_")
+        env_id = "continuous_dummy" if algo.startswith("sac") else "discrete_dummy"
+        args = [
+            f"exp={algo}", "env=dummy", f"env.id={env_id}", "dry_run=True",
+            "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+            "fabric.devices=1", "metric.log_level=0", "checkpoint.every=1",
+            "buffer.memmap=False", "algo.learning_starts=0",
+            f"log_dir={log_dir}", "print_config=False", "algo.run_test=False",
+        ]
+        if algo == "dreamer_v3":
+            args += [
+                "algo=dreamer_v3_XS", "algo.per_rank_batch_size=2",
+                "algo.per_rank_sequence_length=8", "algo.horizon=4",
+                "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
+                "algo.world_model.encoder.cnn_channels_multiplier=4",
+                "algo.dense_units=16",
+                "algo.world_model.recurrent_model.recurrent_state_size=16",
+                "algo.world_model.transition_model.hidden_size=16",
+                "algo.world_model.representation_model.hidden_size=16",
+            ]
+        run(args)
+        ckpt = find_checkpoints(log_dir)[-1]
+
+    from sheeprl_tpu.serve import PolicyService
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+
+    clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 16))
+    per_client = int(os.environ.get("BENCH_SERVE_REQUESTS", 64))
+    service = PolicyService.from_checkpoint(ckpt, ["serve.watch_commits=False"])
+    service.start()  # warms the whole batch ladder before returning
+    obs = {
+        k: np.zeros(shape, np.dtype(dt))
+        for k, (shape, dt) in service.player.obs_spec.items()
+    }
+    # settle the pipeline outside the timed window (first dispatches mix in
+    # host-side warmup noise), then snapshot the compile counter: any compile
+    # during the timed window is a ladder escape
+    for _ in range(4):
+        service.act(obs, timeout=60.0)
+    exe_before, _ = COMPILE_MONITOR.totals()
+    service.latency = type(service.latency)(int(clients * per_client * 1.1))
+
+    barrier = threading.Barrier(clients + 1)
+    errors: list = []
+
+    def worker(wid: int) -> None:
+        barrier.wait()
+        for _ in range(per_client):
+            try:
+                service.act(obs, session=f"bench-{wid}", timeout=120.0)
+            except Exception as e:  # count, don't crash the bench
+                errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(clients)]
+    for t in threads:
+        t.start()
+    barrier.wait()
+    t0 = time.perf_counter()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    exe_after, compile_s = COMPILE_MONITOR.totals()
+    total = clients * per_client - len(errors)
+    stats = service.stats()
+    service.stop()
+    import jax
+
+    return {
+        "metric": (
+            f"serve_{algo}_actions_per_s "
+            f"({clients} clients x {per_client} reqs, "
+            f"ladder {stats['batch_ladder']}, {jax.devices()[0].platform})"
+        ),
+        "value": round(total / elapsed, 3),
+        "unit": "actions/s",
+        "vs_baseline": None,
+        "p50_ms": round(stats["p50_ms"], 3),
+        "p99_ms": round(stats["p99_ms"], 3),
+        "avg_batch": stats["avg_batch"],
+        "padded_frac": stats["padded_frac"],
+        "serve_errors": len(errors),
+        "steady_compiles": exe_after - exe_before,
+        "compile_executables": exe_after,
+        "compile_time_s": round(compile_s, 3),
+    }
+
+
 def _run_bench() -> dict:
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
+    if target == "serve":
+        return bench_serve()
     if target in BASELINE_CPU_WALL_CLOCK_S:
         return bench_cpu_wall_clock(target)
     return bench_dreamer_v3()
@@ -363,6 +475,17 @@ def _watchdog_main() -> None:
 
 
 if __name__ == "__main__":
+    import sys
+
+    # `--mode <target>` CLI alias for BENCH_TARGET (e.g. `bench.py --mode
+    # serve`); the env var form keeps working and is what the watchdog's
+    # child re-exec inherits
+    if "--mode" in sys.argv:
+        idx = sys.argv.index("--mode")
+        if idx + 1 >= len(sys.argv):
+            raise SystemExit("--mode requires a target (serve, dreamer_v3, ppo, ...)")
+        os.environ["BENCH_TARGET"] = sys.argv[idx + 1]
+
     from sheeprl_tpu.utils.utils import force_cpu_backend
 
     if os.environ.get("BENCH_CHILD") == "1" or os.environ.get("JAX_PLATFORMS") == "cpu":

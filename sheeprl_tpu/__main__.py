@@ -7,13 +7,15 @@ Subcommand-style flags mirror the reference's extra entry points
 
 import sys
 
-from sheeprl_tpu.cli import available_agents, evaluation, registration, run
+from sheeprl_tpu.cli import available_agents, evaluation, registration, run, serve
 
 if __name__ == "__main__":
     argv = sys.argv[1:]
     cmd = argv[0].lstrip("-") if argv else ""
     if cmd == "eval":
         evaluation(argv[1:])
+    elif cmd == "serve":
+        serve(argv[1:])
     elif cmd == "register-model":
         registration(argv[1:])
     elif cmd == "agents":

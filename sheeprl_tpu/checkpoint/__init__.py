@@ -20,8 +20,10 @@ from sheeprl_tpu.checkpoint.protocol import (
     is_committed,
     latest_checkpoint,
     list_checkpoints,
+    newer_checkpoint,
     read_manifest,
     verify_checkpoint,
+    wait_for_commit,
 )
 from sheeprl_tpu.checkpoint.serialize import (
     KeyArrayRef,
@@ -49,6 +51,7 @@ __all__ = [
     "latest_checkpoint",
     "list_checkpoints",
     "load_checkpoint",
+    "newer_checkpoint",
     "preemption_requested",
     "read_manifest",
     "resolve_auto_resume",
@@ -56,4 +59,5 @@ __all__ = [
     "snapshot_tree",
     "to_host_tree",
     "verify_checkpoint",
+    "wait_for_commit",
 ]

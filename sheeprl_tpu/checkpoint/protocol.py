@@ -184,6 +184,39 @@ def latest_checkpoint(root: Union[str, os.PathLike]) -> Optional[Path]:
     return ckpts[-1] if ckpts else None
 
 
+def newer_checkpoint(
+    root: Union[str, os.PathLike], after_step: int
+) -> Optional[Path]:
+    """Newest COMMITTED snapshot under ``root`` with step > ``after_step``,
+    or None — the serving layer's commit-watch primitive: a hot-reload
+    watcher polls this with the step it is currently serving, and a non-None
+    return is exactly one durable, fully-committed snapshot to swap to
+    (torn snapshots are invisible here by construction)."""
+    newest = latest_checkpoint(root)
+    if newest is not None and checkpoint_step(newest) > int(after_step):
+        return newest
+    return None
+
+
+def wait_for_commit(
+    root: Union[str, os.PathLike],
+    after_step: int,
+    timeout_s: float,
+    poll_s: float = 0.1,
+) -> Optional[Path]:
+    """Block until a snapshot newer than ``after_step`` is committed under
+    ``root`` (polling the COMMIT markers), or return None on timeout.
+    Test/tooling convenience over :func:`newer_checkpoint`."""
+    deadline = time.monotonic() + timeout_s
+    while True:
+        found = newer_checkpoint(root, after_step)
+        if found is not None:
+            return found
+        if time.monotonic() >= deadline:
+            return None
+        time.sleep(poll_s)
+
+
 def load_step_dir(step_dir: Union[str, os.PathLike], rank: int = 0) -> Any:
     """Load one rank's state from a committed snapshot directory.  Falls
     back to shard 0 when this rank has no shard (e.g. resuming a 2-process

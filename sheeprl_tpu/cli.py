@@ -201,35 +201,46 @@ def run(argv: Optional[List[str]] = None) -> None:
 
 
 def evaluation(argv: Optional[List[str]] = None) -> None:
-    """Evaluate a checkpoint (reference: sheeprl/cli.py:202-268, 369-405)."""
-    import yaml
+    """Evaluate a checkpoint (reference: sheeprl/cli.py:202-268, 369-405).
 
+    Checkpoint discovery and snapshot→policy reconstruction go through
+    ``sheeprl_tpu.serve.loader`` — the SAME path the policy server uses, so
+    evaluation and serving can never disagree on how a snapshot is rebuilt.
+    Algorithms with a registered serving player (ppo/sac/dreamer_v3
+    families) evaluate through the serving player itself; the rest fall
+    back to their ``@register_evaluation`` entrypoint, still fed by the
+    loader's discovery + config resolution.
+    """
     argv = list(sys.argv[1:] if argv is None else argv)
     ckpt_override = [a for a in argv if a.startswith("checkpoint_path=")]
     if not ckpt_override:
         raise ConfigError("evaluation requires checkpoint_path=<path-to-ckpt>")
-    ckpt_path = pathlib.Path(ckpt_override[0].split("=", 1)[1])
     rest = [a for a in argv if not a.startswith("checkpoint_path=")]
 
-    run_cfg_path = ckpt_path.parent.parent / "config.yaml"
-    if not run_cfg_path.is_file():
-        raise ConfigError(f"Cannot find the run config next to the checkpoint: {run_cfg_path}")
-    with open(run_cfg_path) as f:
-        cfg = dotdict(yaml.safe_load(f))
+    from sheeprl_tpu.serve.loader import load_policy, load_run_config, resolve_checkpoint
+    from sheeprl_tpu.serve.players import PLAYER_BUILDERS
 
-    from sheeprl_tpu.config.compose import apply_cli_overrides
+    ckpt_path = resolve_checkpoint(ckpt_override[0].split("=", 1)[1])
+    cfg = load_run_config(ckpt_path, rest)
+    if cfg.algo.name in PLAYER_BUILDERS:
+        from sheeprl_tpu.serve.loader import evaluate_player
+        from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 
-    apply_cli_overrides(cfg, rest)
-    # eval ALWAYS runs single-device, 1 env (reference: sheeprl/cli.py:202-268
-    # forces the same) — applied after the overrides so an env=<group> swap
-    # cannot resurrect the group's num_envs default
-    cfg.fabric.devices = 1
-    cfg.env.num_envs = 1
-    cfg.env.capture_video = cfg.env.get("capture_video", False)
+        fabric, cfg, _, player = load_policy(ckpt_path, rest, cfg=cfg)
+        import_extra_modules(cfg)
+        log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
+        logger = get_logger(fabric, cfg, log_dir)
+        evaluate_player(fabric, cfg, player, log_dir, logger)
+        return
 
+    # legacy registry path (algorithms without a serving player) — discovery
+    # and config resolution above already came from the loader
     import sheeprl_tpu
     from sheeprl_tpu.parallel.fabric import build_fabric
 
+    cfg.fabric.devices = 1
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = cfg.env.get("capture_video", False)
     sheeprl_tpu.register_all_algorithms()
     import_extra_modules(cfg)
     entries = evaluation_registry.get(cfg.algo.name)
@@ -246,6 +257,46 @@ def evaluation(argv: Optional[List[str]] = None) -> None:
     fabric = build_fabric(cfg)
     state = fabric.load(ckpt_path)
     fn(fabric, cfg, state)
+
+
+def serve(argv: Optional[List[str]] = None) -> None:
+    """Serve a committed checkpoint as a continuous-batching policy server.
+
+    Usage:
+        python -m sheeprl_tpu.serve checkpoint_path=<ckpt-or-run-dir> \\
+            [serve.port=7455] [serve.batch_ladder=[1,8,32,128]] [overrides...]
+
+    ``checkpoint_path`` accepts a committed ``step_*`` snapshot directory, a
+    run/version directory (→ newest committed snapshot), or a legacy
+    ``.ckpt`` file.  The server AOT-warms the policy executable at every
+    batch-ladder rung before binding the socket, then hot-swaps params
+    whenever training commits a newer snapshot into the same run directory.
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ckpt_override = [a for a in argv if a.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ConfigError("serve requires checkpoint_path=<ckpt-or-run-dir>")
+    rest = [a for a in argv if not a.startswith("checkpoint_path=")]
+
+    from sheeprl_tpu.serve import PolicyService
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    service = PolicyService.from_checkpoint(ckpt_override[0].split("=", 1)[1], rest)
+    serve_cfg = service.cfg.get("serve") or {}
+    server = PolicyServer(
+        service,
+        host=str(serve_cfg.get("host", "127.0.0.1")),
+        port=int(serve_cfg.get("port", 7455)),
+    )
+    # flush: the smoke/CI parent parses this line off a block-buffered pipe
+    # while serve_forever() never returns to flush it naturally
+    print(
+        f"serving {service.player.algo} (checkpoint step {service.store.step}) "
+        f"on {server.url} — batch ladder {list(service.ladder)}, "
+        f"commit watch {'on' if service.watcher else 'off'}",
+        flush=True,
+    )
+    server.serve_forever()
 
 
 def registration(argv: Optional[List[str]] = None) -> None:

@@ -449,6 +449,32 @@ class CompilePool:
         self._executor.shutdown(wait=False, cancel_futures=True)
 
 
+def warmup_batch_ladder(
+    aot_fn: AOTFunction,
+    spec_fn: Callable[[int], Tuple[Any, ...]],
+    batch_sizes: Tuple[int, ...],
+    pool: Optional["CompilePool"] = None,
+    join: bool = True,
+    timeout: Optional[float] = None,
+) -> list:
+    """AOT-compile ``aot_fn`` at every batch size of a serving ladder.
+
+    ``spec_fn(batch)`` returns the positional argument tuple for one ladder
+    rung — concrete arrays and/or ``jax.ShapeDtypeStruct`` leaves, exactly
+    as the steady-state dispatch will pass them (the abstract signature
+    keys the executable cache, so warm-up specs must match dispatch leaves
+    kind-for-kind).  Distinct rungs compile concurrently on the shared
+    :class:`CompilePool`; with ``join=True`` this blocks until the whole
+    ladder is warm, so a server can guarantee ZERO steady-state compiles
+    before admitting traffic.
+    """
+    pool = pool if pool is not None else get_compile_pool()
+    futures = [pool.submit(aot_fn, *spec_fn(int(b))) for b in batch_sizes]
+    if join:
+        pool.join(timeout)
+    return futures
+
+
 _POOL: Optional[CompilePool] = None
 _POOL_LOCK = threading.Lock()
 

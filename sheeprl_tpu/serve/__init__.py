@@ -1,0 +1,60 @@
+"""Policy-as-a-service: a continuous-batching inference layer.
+
+Turns any COMMITTED training snapshot (``checkpoint/protocol.py``) into a
+persistent, always-warm policy endpoint:
+
+* ``loader``  — checkpoint discovery + player-network rebuild (the single
+  snapshot-reconstruction path, shared with ``sheeprl_tpu.cli:evaluation``);
+* ``players`` — per-algorithm :class:`~sheeprl_tpu.serve.players.PolicyPlayer`
+  builders (dreamer_v3, ppo, sac families) whose step programs are
+  AOT-compiled at a fixed batch-size ladder through ``parallel/compile.py``;
+* ``batcher`` — the continuous-batching engine: admission queue,
+  pad-to-ladder coalescing, response scatter;
+* ``reload``  — a background ``COMMIT`` watcher that hot-swaps params
+  (double-buffered host→device transfer) without dropping in-flight
+  requests;
+* ``service`` — the in-process :class:`PolicyService` API;
+* ``server``/``client`` — a stdlib HTTP surface over it.
+
+See docs/serving.md for the architecture.
+"""
+
+from sheeprl_tpu.serve.batcher import AdmissionQueue, QueueFull, pick_ladder_size
+from sheeprl_tpu.serve.loader import (
+    build_player,
+    evaluate_player,
+    load_policy,
+    load_run_config,
+    resolve_checkpoint,
+)
+from sheeprl_tpu.serve.players import PLAYER_BUILDERS, PolicyPlayer, register_player
+from sheeprl_tpu.serve.service import PolicyService
+
+__all__ = [
+    "AdmissionQueue",
+    "PLAYER_BUILDERS",
+    "PolicyClient",
+    "PolicyPlayer",
+    "PolicyServer",
+    "PolicyService",
+    "QueueFull",
+    "build_player",
+    "evaluate_player",
+    "load_policy",
+    "load_run_config",
+    "pick_ladder_size",
+    "register_player",
+    "resolve_checkpoint",
+]
+
+
+def __getattr__(name):  # lazy: server/client pull in http/urllib machinery
+    if name == "PolicyServer":
+        from sheeprl_tpu.serve.server import PolicyServer
+
+        return PolicyServer
+    if name == "PolicyClient":
+        from sheeprl_tpu.serve.client import PolicyClient
+
+        return PolicyClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

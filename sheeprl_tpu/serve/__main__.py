@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu.serve checkpoint_path=<ckpt> [overrides...]``"""
+
+from sheeprl_tpu.cli import serve
+
+if __name__ == "__main__":
+    serve()

@@ -1,0 +1,93 @@
+"""Stdlib client for the ``sheeprl_tpu.serve`` HTTP surface.
+
+``PolicyClient`` is a thin, dependency-free wrapper over
+``urllib.request`` — the same JSON protocol ``serve/server.py`` speaks,
+including the packed base64 array encoding for pixel observations.  Use
+``session=`` for stateful policies (dreamer_v3): the server keeps one
+latent carry per session id, reset at episode boundaries via
+:meth:`PolicyClient.reset`.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from sheeprl_tpu.serve.server import decode_array, encode_array
+
+
+class ServerError(RuntimeError):
+    """Non-2xx response from the policy server."""
+
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class PolicyClient:
+    def __init__(self, base_url: str, timeout: float = 30.0, packed: bool = False):
+        """``packed=True`` ships/returns arrays as base64 blobs instead of
+        nested JSON lists — much cheaper for image observations."""
+        self.base_url = base_url.rstrip("/")
+        self.timeout = float(timeout)
+        self.packed = bool(packed)
+
+    # -- transport ----------------------------------------------------------
+    def _call(self, method: str, path: str, body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        data = None if body is None else json.dumps(body).encode()
+        req = urllib.request.Request(
+            self.base_url + path,
+            data=data,
+            method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            try:
+                message = json.loads(e.read() or b"{}").get("error", str(e))
+            except Exception:
+                message = str(e)
+            raise ServerError(e.code, message) from None
+
+    # -- API ----------------------------------------------------------------
+    def act(
+        self,
+        obs: Dict[str, np.ndarray],
+        greedy: Optional[bool] = None,
+        session: Optional[str] = None,
+        timeout: Optional[float] = None,
+    ) -> np.ndarray:
+        body: Dict[str, Any] = {
+            "obs": {k: encode_array(np.asarray(v), packed=self.packed) for k, v in obs.items()},
+            "packed": self.packed,
+        }
+        if greedy is not None:
+            body["greedy"] = bool(greedy)
+        if session is not None:
+            body["session"] = session
+        if timeout is not None:
+            body["timeout"] = float(timeout)
+        out = self._call("POST", "/v1/act", body)
+        action = decode_array(out["action"], dtype=out.get("dtype"))
+        self.last_generation = out.get("generation")
+        self.last_checkpoint_step = out.get("checkpoint_step")
+        return np.asarray(action).reshape(out.get("shape", np.asarray(action).shape))
+
+    def reset(self, session: str) -> None:
+        self._call("POST", "/v1/reset", {"session": session})
+
+    def reload(self) -> Dict[str, Any]:
+        """Force one commit-watch poll on the server."""
+        return self._call("POST", "/v1/reload", {})
+
+    def stats(self) -> Dict[str, Any]:
+        return self._call("GET", "/v1/stats")
+
+    def health(self) -> Dict[str, Any]:
+        return self._call("GET", "/healthz")

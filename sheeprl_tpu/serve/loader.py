@@ -1,0 +1,211 @@
+"""Checkpoint discovery + player rebuild — THE snapshot-reconstruction path.
+
+Both the serving layer and ``sheeprl_tpu.cli:evaluation`` go through here,
+so a policy can never be reconstructed two different ways.  Discovery
+accepts every checkpoint spelling in the wild:
+
+* a committed ``step_*`` snapshot directory (the commit protocol's unit),
+* a ``<run>/version_*/checkpoint`` root (→ newest COMMITTED snapshot),
+* a ``version_*`` / run directory (→ its checkpoint root),
+* a legacy flat ``ckpt_*.ckpt`` file.
+
+The run's ``config.yaml`` is found by walking up from the checkpoint (it
+lives next to the ``checkpoint`` directory), merged under any CLI
+overrides, and the player network is rebuilt by the per-algorithm builder
+registered in :mod:`sheeprl_tpu.serve.players`.
+"""
+
+from __future__ import annotations
+
+import pathlib
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from sheeprl_tpu.config.compose import ConfigError
+from sheeprl_tpu.utils.structured import dotdict
+
+
+def resolve_checkpoint(path: Any) -> pathlib.Path:
+    """Resolve any checkpoint spelling to a loadable target: a committed
+    ``step_*`` directory or a legacy ``.ckpt`` file."""
+    from sheeprl_tpu.checkpoint import is_committed, latest_checkpoint
+    from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+
+    p = pathlib.Path(path)
+    if p.is_file():  # legacy flat file
+        return p
+    if not p.exists():
+        raise ConfigError(f"checkpoint path does not exist: {p}")
+    if checkpoint_step(p) >= 0:  # an explicit step_* directory
+        if not is_committed(p):
+            raise ConfigError(
+                f"{p} is an uncommitted (torn) snapshot — it has no COMMIT "
+                "marker and cannot be served or evaluated"
+            )
+        return p
+    # a checkpoint root, version dir, or run dir: find the newest committed
+    # snapshot underneath (searching <p>/checkpoint first, then <p> itself,
+    # then any version_*/checkpoint)
+    candidates = [p / "checkpoint", p]
+    candidates += sorted(
+        p.glob("version_*/checkpoint"),
+        key=lambda d: int(d.parent.name.rsplit("_", 1)[-1]),
+        reverse=True,
+    )
+    for root in candidates:
+        newest = latest_checkpoint(root) if root.is_dir() else None
+        if newest is not None:
+            return newest
+    # legacy flat layout fallback
+    for root in candidates:
+        if root.is_dir():
+            ckpts = sorted(root.glob("ckpt_*.ckpt"), key=lambda f: f.stat().st_mtime)
+            if ckpts:
+                return ckpts[-1]
+    raise ConfigError(f"no committed checkpoint found under {p}")
+
+
+def checkpoint_root(ckpt: Any) -> pathlib.Path:
+    """The directory :func:`~sheeprl_tpu.checkpoint.latest_checkpoint` polls
+    for newer commits — the parent ``checkpoint`` dir of a resolved target."""
+    return pathlib.Path(ckpt).parent
+
+
+def load_run_config(ckpt: Any, overrides: Sequence[str] = ()) -> dotdict:
+    """The run's saved ``config.yaml`` (found next to the checkpoint dir),
+    with ``overrides`` applied on top."""
+    import yaml
+
+    from sheeprl_tpu.config.compose import apply_cli_overrides
+
+    ckpt = pathlib.Path(ckpt)
+    # <version>/checkpoint/step_*  or  <version>/checkpoint/ckpt_*.ckpt
+    for parent in ckpt.parents:
+        cfg_path = parent / "config.yaml"
+        if cfg_path.is_file():
+            with open(cfg_path) as f:
+                cfg = dotdict(yaml.safe_load(f))
+            if overrides:
+                apply_cli_overrides(cfg, list(overrides))
+            return cfg
+    raise ConfigError(f"cannot find the run config next to the checkpoint: {ckpt}")
+
+
+def serve_defaults() -> Dict[str, Any]:
+    """The ``serve`` config group's defaults — run configs saved before the
+    serving layer existed have no ``serve`` section, so callers merge this
+    underneath."""
+    from sheeprl_tpu.config.compose import _find_config_file, _load_yaml, _search_dirs
+
+    path = _find_config_file("serve/default.yaml", _search_dirs())
+    return _load_yaml(path) if path is not None else {}
+
+
+def ensure_serve_config(cfg: dotdict) -> dotdict:
+    """Merge the serve defaults UNDER whatever the run config/overrides set."""
+    from sheeprl_tpu.utils.structured import deep_merge
+
+    merged = deep_merge({"serve": serve_defaults()}, cfg.as_dict() if hasattr(cfg, "as_dict") else dict(cfg))
+    return dotdict(merged)
+
+
+def probe_spaces(cfg: dotdict) -> Tuple[Any, Any]:
+    """Observation/action spaces from ONE probe env instance (exactly how the
+    evaluation entrypoints derive them)."""
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0)()
+    obs_space, action_space = env.observation_space, env.action_space
+    env.close()
+    return obs_space, action_space
+
+
+def build_player(fabric: Any, cfg: dotdict, state: Dict[str, Any]) -> Any:
+    """Rebuild the serving player for ``cfg.algo.name`` from a loaded
+    checkpoint state."""
+    from sheeprl_tpu.serve.players import PLAYER_BUILDERS
+
+    algo = cfg.algo.name
+    builder = PLAYER_BUILDERS.get(algo)
+    if builder is None:
+        raise ConfigError(
+            f"no serving player registered for algorithm '{algo}' "
+            f"(available: {', '.join(sorted(PLAYER_BUILDERS))})"
+        )
+    obs_space, action_space = probe_spaces(cfg)
+    return builder(fabric, cfg, state, obs_space, action_space)
+
+
+def evaluate_player(
+    fabric: Any,
+    cfg: dotdict,
+    player: Any,
+    log_dir: Optional[str] = None,
+    logger: Any = None,
+    greedy: bool = True,
+) -> float:
+    """One evaluation episode through the SERVING player — the same
+    prepare → AOT step → postprocess path ``PolicyService`` dispatches, so
+    ``sheeprl_tpu.cli:evaluation`` and the server can never disagree on how
+    a snapshot acts.  Returns the cumulative reward (logged as
+    ``Test/cumulative_reward`` when a logger is passed)."""
+    import numpy as np
+
+    from sheeprl_tpu.utils.env import make_env
+
+    env = make_env(cfg, cfg.seed, 0, run_name=log_dir, prefix="test")()
+    obs, _ = env.reset(seed=cfg.seed)
+    carry = player.zero_carry_row() if player.stateful else ()
+    greedy_mask = np.asarray([greedy], bool)
+    seed = int(cfg.seed)
+    done, cum_reward = False, 0.0
+    while not done:
+        batched = {k: np.asarray(obs[k])[None] for k in player.obs_spec}
+        carry, actions = player.step_batch(
+            player.params, carry, player.prepare(batched), seed, greedy_mask
+        )
+        seed += 1
+        obs, reward, terminated, truncated, _ = env.step(player.postprocess(actions[:1])[0])
+        done = bool(terminated or truncated)
+        cum_reward += float(reward)
+    env.close()
+    if logger is not None:
+        logger.log_metrics({"Test/cumulative_reward": cum_reward}, 0)
+    return cum_reward
+
+
+def load_policy(
+    checkpoint_path: Any,
+    overrides: Sequence[str] = (),
+    fabric: Optional[Any] = None,
+    cfg: Optional[dotdict] = None,
+) -> Tuple[Any, dotdict, Dict[str, Any], Any]:
+    """One-call snapshot → policy reconstruction.
+
+    Returns ``(fabric, cfg, state, player)``.  Serving (like evaluation) is
+    single-device, single-env: the loaded run config is forced to
+    ``fabric.devices=1`` / ``env.num_envs=1`` after the overrides so an
+    ``env=<group>`` swap cannot resurrect a group's env-count default.
+    ``cfg`` lets a caller that already ran :func:`load_run_config` (the
+    evaluation CLI peeks at ``algo.name`` first) hand its copy over instead
+    of parsing the run YAML twice; it is mutated in place as above.
+    """
+    from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+    from sheeprl_tpu.parallel.fabric import build_fabric
+
+    ckpt = resolve_checkpoint(checkpoint_path)
+    if cfg is None:
+        cfg = load_run_config(ckpt, overrides)
+    cfg.fabric.devices = 1
+    cfg.env.num_envs = 1
+    cfg.env.capture_video = cfg.env.get("capture_video", False)
+    cfg = ensure_serve_config(cfg)
+
+    import sheeprl_tpu
+
+    sheeprl_tpu.register_all_algorithms()
+    if fabric is None:
+        fabric = build_fabric(cfg)
+    state = fabric.load(ckpt)
+    player = build_player(fabric, cfg, state)
+    player.checkpoint_step = checkpoint_step(ckpt)
+    return fabric, cfg, state, player

@@ -1,0 +1,346 @@
+"""Per-algorithm policy players for serving.
+
+A :class:`PolicyPlayer` is the serving-side view of a trained agent: the
+minimal parameter subtree, a host-side observation prepare step, and ONE
+jitted step program ``(params, carry, obs, seed, greedy) -> (carry, action)``
+wrapped in :class:`~sheeprl_tpu.parallel.compile.AOTFunction` so it can be
+AOT-compiled at a fixed ladder of batch sizes and never recompile in steady
+state.
+
+Design constraints that shape the step signature:
+
+* ``greedy`` is a per-row ``bool`` ARRAY, not a static flag — a coalesced
+  batch may mix greedy and sampling requests, and making the flag dynamic
+  keeps it to one executable per batch size (both branches are computed and
+  row-selected; XLA shares the common prefix, and the extra sample is noise
+  next to the network forward).
+* ``seed`` is a dynamic ``int32`` scalar: the key is derived inside the
+  program (``jax.random.PRNGKey(seed)``), so the host just increments a
+  counter and no per-dispatch device key plumbing can perturb the abstract
+  signature.
+* ``carry`` is ``()`` for stateless players (ppo, sac) and the latent-state
+  tuple for dreamer_v3; per-session carries are scattered/gathered by the
+  batcher on the host.
+
+The same players back ``sheeprl_tpu.cli:evaluation`` (via ``serve.loader``),
+so evaluation and serving can never disagree on how a snapshot is
+reconstructed.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.parallel.compile import AOTFunction
+
+PLAYER_BUILDERS: Dict[str, Callable] = {}
+
+
+def register_player(*algo_names: str) -> Callable:
+    """Class/function decorator registering a player builder for algo names.
+
+    A builder has signature
+    ``(fabric, cfg, state, obs_space, action_space) -> PolicyPlayer``.
+    """
+
+    def deco(fn: Callable) -> Callable:
+        for name in algo_names:
+            PLAYER_BUILDERS[name] = fn
+        return fn
+
+    return deco
+
+
+@dataclass
+class PolicyPlayer:
+    """Serving-side policy: prepare → step (AOT) → postprocess.
+
+    ``step`` maps ``(params, carry, prepared_obs, seed, greedy_mask)`` to
+    ``(new_carry, actions)`` where ``actions`` are already env-shaped on the
+    device side (discrete → float branch indices); ``postprocess`` finishes
+    the host-side conversion (int casts, bound rescaling).
+    """
+
+    algo: str
+    params: Any  # device-resident player parameter subtree
+    step: AOTFunction
+    prepare: Callable[[Dict[str, np.ndarray]], Dict[str, np.ndarray]]
+    postprocess: Callable[[np.ndarray], np.ndarray]
+    obs_spec: Dict[str, Tuple[Tuple[int, ...], str]]  # raw per-request spec
+    action_shape: Tuple[int, ...]  # per-request env action shape
+    is_continuous: bool
+    actions_dim: Tuple[int, ...]
+    stateful: bool = False
+    carry_spec: Tuple[Tuple[Tuple[int, ...], str], ...] = ()  # per-row leaves
+    checkpoint_step: int = -1
+
+    # -- carry handling (host side; per-row leaves have leading dim 1) ------
+    def zero_carry(self, batch: int) -> Tuple[np.ndarray, ...]:
+        return tuple(
+            np.zeros((batch, *shape), dtype=np.dtype(dt)) for shape, dt in self.carry_spec
+        )
+
+    def zero_carry_row(self) -> Tuple[np.ndarray, ...]:
+        return self.zero_carry(1)
+
+    # -- batched dispatch ----------------------------------------------------
+    def step_batch(
+        self,
+        params: Any,
+        carry: Tuple[np.ndarray, ...],
+        obs: Dict[str, np.ndarray],
+        seed: int,
+        greedy: np.ndarray,
+    ) -> Tuple[Tuple[np.ndarray, ...], np.ndarray]:
+        """One batched policy step.  ``obs`` must already be prepared and
+        padded to a ladder batch size; returns host arrays."""
+        new_carry, actions = self.step(
+            params, carry, obs, np.int32(seed), np.asarray(greedy, bool)
+        )
+        new_carry = tuple(np.asarray(c) for c in new_carry)
+        return new_carry, np.asarray(actions)
+
+    # -- warm-up -------------------------------------------------------------
+    def batch_specs(self, batch: int) -> Tuple[Any, ...]:
+        """``(params, carry, obs, seed, greedy)`` arguments for warming
+        ladder batch size ``batch``.  Params are the REAL device arrays
+        (their placement is part of the abstract signature); everything else
+        is concrete zero-filled HOST arrays — the same leaf kind
+        (``np.ndarray``) the dispatcher passes, so the warm-up lands in
+        exactly the cache slot steady-state dispatch will hit."""
+        obs_spec = {
+            k: np.zeros((batch, *shape), np.dtype(dt))
+            for k, (shape, dt) in self._prep_spec.items()
+        }
+        return (
+            self.params,
+            self.zero_carry(batch),
+            obs_spec,
+            np.int32(0),
+            np.zeros((batch,), bool),
+        )
+
+    # prepared-obs per-row spec, derived once from a zero probe batch
+    _prep_spec: Dict[str, Tuple[Tuple[int, ...], str]] = field(default_factory=dict)
+
+    def finalize(self) -> "PolicyPlayer":
+        """Derive the prepared-observation spec from a size-1 zero batch."""
+        probe = {
+            k: np.zeros((1, *shape), dtype=np.dtype(dt)) for k, (shape, dt) in self.obs_spec.items()
+        }
+        prepped = self.prepare(probe)
+        self._prep_spec = {
+            k: (tuple(np.asarray(v).shape[1:]), str(np.asarray(v).dtype))
+            for k, v in prepped.items()
+        }
+        return self
+
+
+def _split_branches(a: np.ndarray, actions_dim: Sequence[int]) -> np.ndarray:
+    """One-hot concat (B, sum(dims)) → float branch indices (B, n_branches)."""
+    idx, start = [], 0
+    for d in actions_dim:
+        idx.append(np.argmax(a[..., start : start + d], axis=-1))
+        start += d
+    return np.stack(idx, axis=-1).astype(np.float32)
+
+
+def _obs_spec_from_space(obs_space: Any, keys: Sequence[str]) -> Dict[str, Any]:
+    return {k: (tuple(obs_space[k].shape), str(obs_space[k].dtype)) for k in keys}
+
+
+# ---------------------------------------------------------------------------
+# PPO family
+# ---------------------------------------------------------------------------
+
+
+@register_player("ppo", "ppo_decoupled")
+def build_ppo_player(fabric: Any, cfg: Any, state: Dict[str, Any], obs_space: Any, action_space: Any) -> PolicyPlayer:
+    from sheeprl_tpu.algos.ppo.agent import build_agent, sample_actions
+    from sheeprl_tpu.algos.ppo.utils import actions_for_env, obs_to_np, spaces_to_dims
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    actions_dim, is_continuous = spaces_to_dims(action_space)
+    agent, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, state["agent"]
+    )
+    dist_type = cfg.get("distribution", {}).get("type", "auto")
+
+    def _step(p, carry, obs, seed, greedy):
+        key = jax.random.PRNGKey(seed)
+        out, _ = agent.apply(p, obs)
+        a_sample, _, _ = sample_actions(
+            out, actions_dim, is_continuous, key, greedy=False, dist_type=dist_type
+        )
+        a_greedy, _, _ = sample_actions(
+            out, actions_dim, is_continuous, key, greedy=True, dist_type=dist_type
+        )
+        return carry, jnp.where(greedy[:, None], a_greedy, a_sample)
+
+    def prepare(obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out = {k: obs_to_np(obs[k], is_image=True) for k in cnn_keys}
+        out.update({k: obs_to_np(obs[k], is_image=False) for k in mlp_keys})
+        return out
+
+    return PolicyPlayer(
+        algo=cfg.algo.name,
+        params=params,
+        step=fabric.compile(_step, name=f"serve_step:{cfg.algo.name}"),
+        prepare=prepare,
+        postprocess=lambda a: actions_for_env(a, action_space),
+        obs_spec=_obs_spec_from_space(obs_space, cnn_keys + mlp_keys),
+        action_shape=tuple(np.shape(action_space.sample())),
+        is_continuous=is_continuous,
+        actions_dim=tuple(actions_dim),
+    ).finalize()
+
+
+# ---------------------------------------------------------------------------
+# SAC family
+# ---------------------------------------------------------------------------
+
+
+@register_player("sac", "sac_decoupled")
+def build_sac_player(fabric: Any, cfg: Any, state: Dict[str, Any], obs_space: Any, action_space: Any) -> PolicyPlayer:
+    from sheeprl_tpu.algos.sac.agent import build_agent
+    from sheeprl_tpu.utils.distribution import TanhNormal
+
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    obs_dim = int(sum(np.prod(obs_space[k].shape) for k in mlp_keys))
+    act_dim = int(np.prod(action_space.shape))
+    actor, _, params = build_agent(fabric, act_dim, cfg, obs_dim, state["agent"])
+    # serving only needs the actor subtree — the critics stay on the host
+    actor_params = fabric.replicate({"actor": params["actor"]})
+
+    def _step(p, carry, obs, seed, greedy):
+        key = jax.random.PRNGKey(seed)
+        mean, log_std = actor.apply(p["actor"], obs["__sac_obs__"])
+        dist = TanhNormal(mean, jnp.exp(log_std))
+        a = jnp.where(greedy[:, None], dist.mode(), dist.sample(key))
+        return carry, a
+
+    def prepare(obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        parts = [
+            np.asarray(obs[k], np.float32).reshape(np.asarray(obs[k]).shape[0], -1)
+            for k in mlp_keys
+        ]
+        return {"__sac_obs__": np.concatenate(parts, axis=-1)}
+
+    low = np.asarray(action_space.low, np.float32)
+    high = np.asarray(action_space.high, np.float32)
+
+    def postprocess(a: np.ndarray) -> np.ndarray:
+        # actor outputs [-1, 1]; rescale to the env's bounds (sac.utils.test)
+        return low + (np.asarray(a, np.float32) + 1.0) * 0.5 * (high - low)
+
+    return PolicyPlayer(
+        algo=cfg.algo.name,
+        params=actor_params,
+        step=fabric.compile(_step, name=f"serve_step:{cfg.algo.name}"),
+        prepare=prepare,
+        postprocess=postprocess,
+        obs_spec=_obs_spec_from_space(obs_space, mlp_keys),
+        action_shape=tuple(action_space.shape),
+        is_continuous=True,
+        actions_dim=(act_dim,),
+    ).finalize()
+
+
+# ---------------------------------------------------------------------------
+# DreamerV3
+# ---------------------------------------------------------------------------
+
+
+@register_player("dreamer_v3")
+def build_dreamer_v3_player(fabric: Any, cfg: Any, state: Dict[str, Any], obs_space: Any, action_space: Any) -> PolicyPlayer:
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
+    from sheeprl_tpu.utils.utils import merge_framestack
+
+    cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
+    mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
+    actions_dim, is_continuous = spaces_to_dims(action_space)
+    world_model, actor, _, params = build_agent(
+        fabric, actions_dim, is_continuous, cfg, obs_space, state["agent"]
+    )
+    WM = type(world_model)
+    act_width = int(sum(actions_dim))
+    rec_size = int(cfg.algo.world_model.recurrent_model.recurrent_state_size)
+    stoch_flat = int(world_model.stoch_flat)
+    player_params = fabric.replicate(
+        {"world_model": params["world_model"], "actor": params["actor"]}
+    )
+
+    def _step(p, carry, obs, seed, greedy):
+        h, z, prev_a = carry
+        key = jax.random.PRNGKey(seed)
+        k_repr, k_act = jax.random.split(key)
+        embed = world_model.apply(p["world_model"], obs, method=WM.encode)
+        h, z, _, _ = world_model.apply(
+            p["world_model"], h, z, prev_a, embed,
+            jnp.zeros((h.shape[0], 1)), k_repr, method=WM.dynamic,
+        )
+        latent = jnp.concatenate([z, h], -1)
+        out = actor.apply(p["actor"], latent)
+        a = jnp.where(
+            greedy[:, None],
+            actor.sample(out, k_act, greedy=True),
+            actor.sample(out, k_act, greedy=False),
+        )
+        return (h, z, a), a
+
+    def prepare(obs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        out: Dict[str, np.ndarray] = {}
+        for k in cnn_keys:
+            x = np.asarray(obs[k])
+            if x.ndim == 5:  # (B, S, H, W, C) frame stack → channels
+                x = merge_framestack(x)
+            out[k] = np.asarray(x, np.float32) / 255.0 - 0.5
+        for k in mlp_keys:
+            x = np.asarray(obs[k], np.float32)
+            out[k] = x.reshape(x.shape[0], -1)
+        return out
+
+    def postprocess(a: np.ndarray) -> np.ndarray:
+        if not is_continuous:
+            a = _split_branches(a, actions_dim)
+        return actions_for_env(a, action_space)
+
+    return PolicyPlayer(
+        algo=cfg.algo.name,
+        params=player_params,
+        step=fabric.compile(_step, name=f"serve_step:{cfg.algo.name}"),
+        prepare=prepare,
+        postprocess=postprocess,
+        obs_spec=_obs_spec_from_space(obs_space, cnn_keys + mlp_keys),
+        action_shape=tuple(np.shape(action_space.sample())),
+        is_continuous=is_continuous,
+        actions_dim=tuple(actions_dim),
+        stateful=True,
+        carry_spec=(
+            ((rec_size,), "float32"),
+            ((stoch_flat,), "float32"),
+            ((act_width,), "float32"),
+        ),
+    ).finalize()
+
+
+def extract_player_state(player: PolicyPlayer, fabric: Any, agent_state: Dict[str, Any]) -> Any:
+    """The player-relevant device subtree of a freshly-loaded ``agent``
+    checkpoint entry — the hot-reload twin of what each builder put in
+    ``player.params`` (double-buffered: this allocates NEW device buffers
+    while the old ones keep serving)."""
+    if player.algo.startswith("sac"):
+        return fabric.replicate({"actor": agent_state["actor"]})
+    if player.algo == "dreamer_v3":
+        return fabric.replicate(
+            {"world_model": agent_state["world_model"], "actor": agent_state["actor"]}
+        )
+    return fabric.replicate(agent_state)

@@ -1,0 +1,140 @@
+"""Hot checkpoint reload: COMMIT watcher + double-buffered param swap.
+
+:class:`ParamStore` owns the device parameter subtree the dispatcher reads;
+:class:`CommitWatcher` polls the run's checkpoint directory for a newer
+``COMMIT`` marker (``checkpoint.protocol.newer_checkpoint``), loads the new
+shard on its OWN thread, transfers it host→device into FRESH buffers while
+the old ones keep serving (double buffering), and then swaps the store's
+pointer under a lock.
+
+In-flight requests are never dropped: a dispatch captures the params
+reference once at batch start, so a swap mid-batch only affects the NEXT
+batch.  Shapes/dtypes/placement of the new tree are identical to the old
+one (same agent, same fabric), so the warmed executables accept it without
+recompiling.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+class ParamStore:
+    """Versioned, thread-safe pointer to the serving parameter subtree."""
+
+    def __init__(self, params: Any, step: int = -1):
+        self._lock = threading.Lock()
+        self._params = params
+        self._generation = 0
+        self._step = int(step)
+
+    def get(self) -> Any:
+        with self._lock:
+            return self._params
+
+    def snapshot(self) -> tuple:
+        """(params, generation, checkpoint_step) under one lock hold."""
+        with self._lock:
+            return self._params, self._generation, self._step
+
+    @property
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    @property
+    def step(self) -> int:
+        with self._lock:
+            return self._step
+
+    def swap(self, params: Any, step: int) -> int:
+        """Install a new (already device-resident) tree; returns the new
+        generation.  The old tree stays alive until every in-flight dispatch
+        holding its reference finishes — garbage collection IS the second
+        half of the double buffer."""
+        with self._lock:
+            self._params = params
+            self._step = int(step)
+            self._generation += 1
+            return self._generation
+
+
+class CommitWatcher:
+    """Background thread hot-swapping params on every new ``COMMIT``."""
+
+    def __init__(
+        self,
+        ckpt_root: Any,
+        store: ParamStore,
+        load_params: Callable[[Any], Any],
+        poll_s: float = 2.0,
+        on_reload: Optional[Callable[[int, int], None]] = None,
+    ):
+        """``load_params(step_dir) -> device tree`` does the rank-shard read
+        + host→device transfer (built by the service from the player's
+        extract rule); ``on_reload(generation, step)`` is a notification
+        hook (stats, logs)."""
+        self._ckpt_root = ckpt_root
+        self._store = store
+        self._load_params = load_params
+        self._poll_s = float(poll_s)
+        self._on_reload = on_reload
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._poll_lock = threading.Lock()
+        self.reloads = 0
+        self.last_error: Optional[str] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="sheeprl-serve-reload", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def poll_once(self) -> Optional[int]:
+        """One synchronous check (also used by the HTTP ``/v1/reload``
+        endpoint and tests): swap if a newer commit exists, return the new
+        generation or None.  Serialized: a concurrent poll (watcher thread +
+        ``/v1/reload`` handler) could otherwise finish a SLOW load of step N
+        after a faster poll already swapped to N+1 and roll the server back
+        to stale params — the lock makes every check-load-swap atomic, and
+        the entry check rereads ``store.step`` so the loser just no-ops."""
+        from sheeprl_tpu.checkpoint.protocol import checkpoint_step, newer_checkpoint
+
+        with self._poll_lock:
+            found = newer_checkpoint(self._ckpt_root, self._store.step)
+            if found is None:
+                return None
+            try:
+                new_params = self._load_params(found)
+                # the transfer above allocated fresh device buffers; fence it
+                # so the swap publishes a fully-materialized tree
+                for leaf in jax.tree_util.tree_leaves(new_params):
+                    if hasattr(leaf, "block_until_ready"):
+                        leaf.block_until_ready()
+            except Exception as e:  # a torn read mid-GC, OOM, … — keep serving
+                self.last_error = f"{type(e).__name__}: {e}"
+                return None
+            gen = self._store.swap(new_params, checkpoint_step(found))
+            self.reloads += 1
+            self.last_error = None
+            if self._on_reload is not None:
+                self._on_reload(gen, self._store.step)
+            return gen
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.poll_once()
+            except Exception as e:  # never let the watcher die silently
+                self.last_error = f"{type(e).__name__}: {e}"
+            self._stop.wait(self._poll_s)

@@ -8,16 +8,19 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/4: unit + E2E dry-run suite ==="
+echo "=== stage 1/5: unit + E2E dry-run suite ==="
 python -m pytest tests/ -x -q --ignore=tests/test_regression --ignore=tests/test_checkpoint
 
-echo "=== stage 2/4: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
+echo "=== stage 2/5: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
 python -m pytest tests/test_checkpoint -q
 
-echo "=== stage 3/4: numeric regression (goldens + reference fixture) ==="
+echo "=== stage 3/5: numeric regression (goldens + reference fixture) ==="
 python -m pytest tests/test_regression -q
 
-echo "=== stage 4/4: multichip dryrun (virtual 8-device mesh) ==="
+echo "=== stage 4/5: multichip dryrun (virtual 8-device mesh) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "=== stage 5/5: policy-serving smoke (HTTP server + batched requests + clean shutdown) ==="
+python tests/serve_smoke.py
 
 echo "CI gate: ALL GREEN"

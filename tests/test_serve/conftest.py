@@ -1,0 +1,70 @@
+"""Shared fixtures: tiny committed dryrun checkpoints to serve from.
+
+Session-scoped — training even a tiny agent dominates the module's wall
+clock, so every test in the package reuses the same snapshot.
+"""
+
+import pytest
+
+from sheeprl_tpu.cli import run
+from tests.ckpt_utils import find_checkpoints
+
+
+def _train_tiny(algo: str, env_id: str, log_dir: str, extra=()) -> str:
+    run(
+        [
+            f"exp={algo}",
+            "env=dummy",
+            f"env.id={env_id}",
+            "dry_run=True",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.every=1",
+            "buffer.memmap=False",
+            "algo.learning_starts=0",
+            f"log_dir={log_dir}",
+            "print_config=False",
+            "algo.run_test=False",
+            *extra,
+        ]
+    )
+    ckpts = find_checkpoints(log_dir)
+    assert ckpts, f"dryrun produced no committed checkpoint under {log_dir}"
+    return ckpts[-1]
+
+
+@pytest.fixture(scope="session")
+def sac_ckpt(tmp_path_factory):
+    log_dir = tmp_path_factory.mktemp("serve_sac")
+    return _train_tiny("sac", "continuous_dummy", str(log_dir))
+
+
+@pytest.fixture(scope="session")
+def ppo_ckpt(tmp_path_factory):
+    log_dir = tmp_path_factory.mktemp("serve_ppo")
+    return _train_tiny("ppo", "discrete_dummy", str(log_dir))
+
+
+DV3_TINY = (
+    "algo=dreamer_v3_XS",
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=8",
+    "algo.horizon=4",
+    "algo.cnn_keys.encoder=[rgb]",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.dense_units=16",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+)
+
+
+@pytest.fixture(scope="session")
+def dv3_ckpt(tmp_path_factory):
+    log_dir = tmp_path_factory.mktemp("serve_dv3")
+    return _train_tiny("dreamer_v3", "discrete_dummy", str(log_dir), DV3_TINY)

@@ -1,0 +1,199 @@
+"""Unit tests: pad-to-ladder selection + admission-queue fairness and
+backpressure (no jax, no training — pure host logic)."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.serve.batcher import (
+    AdmissionQueue,
+    LatencyTracker,
+    QueueFull,
+    ServiceStopped,
+    _Request,
+    pick_ladder_size,
+)
+
+LADDER = (1, 8, 32, 128)
+
+
+def _req(i: int = 0) -> _Request:
+    return _Request({"x": np.asarray([i])}, greedy=True, session=None)
+
+
+# -- pad-to-ladder -----------------------------------------------------------
+
+
+def test_pick_ladder_size_exact_and_padded():
+    assert pick_ladder_size(1, LADDER) == 1
+    assert pick_ladder_size(2, LADDER) == 8
+    assert pick_ladder_size(8, LADDER) == 8
+    assert pick_ladder_size(9, LADDER) == 32
+    assert pick_ladder_size(128, LADDER) == 128
+
+
+def test_pick_ladder_size_unsorted_ladder():
+    assert pick_ladder_size(5, (128, 1, 32, 8)) == 8
+
+
+def test_pick_ladder_size_rejects_overflow_and_empty():
+    with pytest.raises(ValueError):
+        pick_ladder_size(129, LADDER)  # above the top rung: never recompile
+    with pytest.raises(ValueError):
+        pick_ladder_size(0, LADDER)
+
+
+# -- admission queue: fairness -----------------------------------------------
+
+
+def test_queue_strict_fifo_order():
+    q = AdmissionQueue(max_pending=64)
+    reqs = [_req(i) for i in range(10)]
+    for r in reqs:
+        q.put(r)
+    batch = q.get_batch(max_batch=10, max_wait_s=0.0)
+    assert batch == reqs  # arrival order, nobody reordered/starved
+
+
+def test_queue_coalesces_up_to_max_batch():
+    q = AdmissionQueue(max_pending=64)
+    for i in range(12):
+        q.put(_req(i))
+    first = q.get_batch(max_batch=8, max_wait_s=0.0)
+    second = q.get_batch(max_batch=8, max_wait_s=0.0)
+    assert len(first) == 8 and len(second) == 4
+
+
+def test_queue_max_wait_anchored_to_oldest():
+    """The dispatch clock starts at the OLDEST request's arrival — a slow
+    trickle of later arrivals cannot hold the head request hostage."""
+    q = AdmissionQueue(max_pending=64)
+    q.put(_req(0))
+    t0 = time.perf_counter()
+    batch = q.get_batch(max_batch=8, max_wait_s=0.15)
+    waited = time.perf_counter() - t0
+    assert len(batch) == 1
+    assert waited < 1.0  # returned at ~max_wait, not blocked indefinitely
+
+
+def test_queue_dispatches_immediately_when_full_batch_waiting():
+    q = AdmissionQueue(max_pending=64)
+    for i in range(8):
+        q.put(_req(i))
+    t0 = time.perf_counter()
+    batch = q.get_batch(max_batch=8, max_wait_s=5.0)
+    assert len(batch) == 8
+    assert time.perf_counter() - t0 < 1.0  # did NOT wait out max_wait
+
+
+# -- admission queue: backpressure -------------------------------------------
+
+
+def test_queue_backpressure_nonblocking():
+    q = AdmissionQueue(max_pending=2)
+    q.put(_req(0))
+    q.put(_req(1))
+    with pytest.raises(QueueFull):
+        q.put(_req(2), block=False)
+
+
+def test_queue_backpressure_blocking_timeout():
+    q = AdmissionQueue(max_pending=1)
+    q.put(_req(0))
+    t0 = time.perf_counter()
+    with pytest.raises(QueueFull):
+        q.put(_req(1), block=True, timeout=0.1)
+    assert time.perf_counter() - t0 >= 0.1
+
+
+def test_queue_blocked_put_unblocks_on_pop():
+    q = AdmissionQueue(max_pending=1)
+    q.put(_req(0))
+    ok = threading.Event()
+
+    def producer():
+        q.put(_req(1), block=True, timeout=5.0)
+        ok.set()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.05)
+    q.get_batch(max_batch=1, max_wait_s=0.0)  # frees a slot
+    t.join(5.0)
+    assert ok.is_set()
+
+
+def test_queue_close_rejects_and_returns_pending():
+    q = AdmissionQueue(max_pending=8)
+    r0, r1 = _req(0), _req(1)
+    q.put(r0)
+    q.put(r1)
+    pending = q.close()
+    assert pending == [r0, r1]
+    with pytest.raises(ServiceStopped):
+        q.put(_req(2))
+    assert q.get_batch(max_batch=8, max_wait_s=0.0) == []
+
+
+# -- request handle / latency ------------------------------------------------
+
+
+def test_request_resolve_and_fail():
+    r = _req()
+    r.resolve(np.asarray([1.0]))
+    assert r.wait(1.0) == np.asarray([1.0])
+    r2 = _req()
+    r2.fail(RuntimeError("boom"))
+    with pytest.raises(RuntimeError, match="boom"):
+        r2.wait(1.0)
+
+
+def test_latency_tracker_percentiles():
+    lt = LatencyTracker(window=128)
+    for ms in range(1, 101):
+        lt.record(ms / 1e3)
+    p = lt.percentiles((50, 99))
+    assert 45 <= p["p50_ms"] <= 55
+    assert 95 <= p["p99_ms"] <= 100
+
+
+def test_request_timeout_marks_cancelled():
+    """A wait() timeout (the HTTP 504 path) flags the still-queued request so
+    the dispatcher drops it instead of spending a batch slot — and, for
+    stateful sessions, advancing the latent chain on an observation the
+    client will resend."""
+    r = _req()
+    with pytest.raises(TimeoutError):
+        r.wait(0.01)
+    assert r.cancelled
+    done = _req()
+    done.resolve(np.asarray([1.0]))
+    done.wait(1.0)
+    assert not done.cancelled
+
+
+# -- same-session coalescing -------------------------------------------------
+
+
+def test_session_waves_chain_duplicate_sessions():
+    """Two pipelined requests for one stateful session must not share a
+    batch (both would read the same pre-batch carry); sessionless rows pack
+    into the first wave."""
+    from sheeprl_tpu.serve.service import _session_waves
+
+    def req(session):
+        return _Request({"x": np.zeros(1)}, greedy=True, session=session)
+
+    a1, b1, n1, a2, n2, a3 = (
+        req("a"), req("b"), req(None), req("a"), req(None), req("a")
+    )
+    waves = _session_waves([a1, b1, n1, a2, n2, a3])
+    assert waves == [[a1, b1, n1, n2], [a2], [a3]]  # per-session order kept
+    # no duplicates inside any wave
+    for wave in waves:
+        ids = [r.session for r in wave if r.session is not None]
+        assert len(ids) == len(set(ids))
+    # all-sessionless (and stateless players skip splitting entirely)
+    assert _session_waves([n1, n2]) == [[n1, n2]]

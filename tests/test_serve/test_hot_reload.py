@@ -1,0 +1,123 @@
+"""The hot-reload drill from the acceptance criteria: serve a committed
+dryrun checkpoint, stream requests continuously, commit a NEWER snapshot
+mid-stream, and assert (a) zero dropped/errored requests and (b) post-reload
+actions come from the new params.
+
+The new snapshot's actor is forged so its greedy action is unmistakable:
+mean-head kernel zeroed, bias +100 → tanh(100) = 1.0 exactly on every
+action dim, which the trained tiny actor never produces on a zero obs.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.checkpoint.protocol import (
+    checkpoint_step,
+    step_dir_name,
+    write_commit,
+    write_shard,
+)
+from sheeprl_tpu.serve import PolicyService
+from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+
+
+def _forge_saturated_actor(state):
+    """Copy of the checkpoint state whose actor mean head outputs +100."""
+    import copy
+
+    new_state = copy.deepcopy(state)
+
+    def patch(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "mean" and isinstance(v, dict) and "bias" in v:
+                    v["kernel"] = np.zeros_like(np.asarray(v["kernel"]))
+                    v["bias"] = np.full_like(np.asarray(v["bias"]), 100.0)
+                else:
+                    patch(v)
+
+    patch(new_state["agent"]["actor"])
+    return new_state
+
+
+def test_hot_reload_mid_stream(sac_ckpt):
+    svc = PolicyService.from_checkpoint(
+        sac_ckpt,
+        [
+            "serve.max_wait_ms=2",
+            "serve.reload_poll_s=0.1",
+            "serve.batch_ladder=[1,8,32]",
+        ],
+    )
+    assert svc.watcher is not None, "serving a run dir must arm the commit watcher"
+    svc.start()
+    try:
+        obs = {
+            k: np.zeros(shape, np.dtype(dt))
+            for k, (shape, dt) in svc.player.obs_spec.items()
+        }
+        # old params: tiny trained actor, greedy action nowhere near the bound
+        a_old = svc.act(obs, greedy=True, timeout=60.0)
+        assert np.all(np.abs(a_old) < 0.9)
+        exe_before, _ = COMPILE_MONITOR.totals()
+
+        # continuous request stream across the swap
+        errors, actions, stop = [], [], threading.Event()
+
+        def stream(wid: int):
+            while not stop.is_set():
+                try:
+                    actions.append(svc.act(obs, greedy=True, timeout=60.0))
+                except Exception as e:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=stream, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # in-flight traffic before the commit
+
+        # commit a NEWER snapshot into the same run's checkpoint root
+        old_state = svc.fabric.load(sac_ckpt)
+        new_state = _forge_saturated_actor(old_state)
+        new_step = checkpoint_step(sac_ckpt) + 100
+        step_dir = svc.ckpt_root / step_dir_name(new_step)
+        step_dir.mkdir()
+        write_shard(step_dir, 0, new_state)
+        assert write_commit(step_dir, new_step, world=1, timeout_s=30.0)
+
+        # the watcher must pick it up without the stream stopping
+        deadline = time.monotonic() + 60.0
+        while svc.store.generation == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert svc.store.generation == 1, f"no hot reload (last_error={svc.watcher.last_error})"
+        assert svc.store.step == new_step
+
+        time.sleep(0.3)  # post-swap traffic
+        stop.set()
+        for t in threads:
+            t.join(60.0)
+
+        # (a) zero dropped/errored requests across the swap
+        assert not errors
+        assert svc.stats()["errors"] == 0
+        assert len(actions) > 0
+
+        # (b) post-reload actions come from the NEW params: saturated bound
+        a_new = svc.act(obs, greedy=True, timeout=60.0)
+        np.testing.assert_allclose(a_new, np.ones_like(a_new), atol=1e-3)
+
+        # and the swap compiled nothing: same shapes, same executables
+        exe_after, _ = COMPILE_MONITOR.totals()
+        assert exe_after == exe_before
+
+        # the stream must contain BOTH regimes (old actions, then saturated)
+        saturated = [a for a in actions if np.all(np.abs(a - 1.0) < 1e-3)]
+        unsaturated = [a for a in actions if np.all(np.abs(a) < 0.9)]
+        assert saturated, "no post-reload action observed in the stream"
+        assert unsaturated, "no pre-reload action observed in the stream"
+        assert svc.watcher.reloads == 1
+    finally:
+        svc.stop()

@@ -1,0 +1,118 @@
+"""Hot-reload unit tests: double-buffered param store + COMMIT watcher
+(forged snapshot dirs, injected loaders — no jax, no training)."""
+
+import os
+
+import pytest
+
+from sheeprl_tpu.checkpoint.protocol import (
+    step_dir_name,
+    write_commit,
+    write_shard,
+)
+from sheeprl_tpu.serve.reload import CommitWatcher, ParamStore
+
+
+def _commit(root, step: int, payload) -> str:
+    d = root / step_dir_name(step)
+    os.makedirs(d, exist_ok=True)
+    write_shard(d, 0, payload)
+    assert write_commit(d, step, world=1, timeout_s=5.0)
+    return str(d)
+
+
+# -- ParamStore --------------------------------------------------------------
+
+
+def test_param_store_swap_bumps_generation():
+    store = ParamStore({"w": 1}, step=10)
+    assert (store.get(), store.generation, store.step) == ({"w": 1}, 0, 10)
+    gen = store.swap({"w": 2}, step=20)
+    assert gen == 1
+    assert (store.get(), store.generation, store.step) == ({"w": 2}, 1, 20)
+
+
+def test_param_store_double_buffering():
+    """A reader holding the OLD tree keeps it alive across a swap — the swap
+    only redirects the pointer for FUTURE snapshots."""
+    old = {"w": [1.0]}
+    store = ParamStore(old, step=1)
+    held, gen_at_dispatch, _ = store.snapshot()  # an in-flight batch
+    store.swap({"w": [2.0]}, step=2)
+    assert held is old and held["w"] == [1.0]  # untouched, still serving
+    assert gen_at_dispatch == 0
+    assert store.snapshot()[0]["w"] == [2.0]  # next batch gets the new tree
+
+
+# -- CommitWatcher -----------------------------------------------------------
+
+
+def test_watcher_swaps_on_newer_commit(tmp_path):
+    _commit(tmp_path, 10, {"v": 10})
+    store = ParamStore("old", step=10)
+    loaded = []
+
+    def load(step_dir):
+        loaded.append(str(step_dir))
+        return f"params@{os.path.basename(step_dir)}"
+
+    w = CommitWatcher(tmp_path, store, load, poll_s=60.0)
+    assert w.poll_once() is None  # nothing newer than step 10
+    _commit(tmp_path, 20, {"v": 20})
+    gen = w.poll_once()
+    assert gen == 1 and w.reloads == 1
+    assert store.step == 20 and store.get() == f"params@{step_dir_name(20)}"
+    assert loaded == [str(tmp_path / step_dir_name(20))]
+
+
+def test_watcher_ignores_uncommitted_snapshot(tmp_path):
+    _commit(tmp_path, 10, {"v": 10})
+    store = ParamStore("old", step=10)
+    w = CommitWatcher(tmp_path, store, lambda d: "new", poll_s=60.0)
+    # torn snapshot: shard written, COMMIT never lands
+    torn = tmp_path / step_dir_name(20)
+    os.makedirs(torn)
+    write_shard(torn, 0, {"v": 20})
+    assert w.poll_once() is None
+    assert store.get() == "old" and store.step == 10
+
+
+def test_watcher_keeps_serving_on_load_error(tmp_path):
+    _commit(tmp_path, 10, {"v": 10})
+    store = ParamStore("old", step=10)
+
+    def bad_load(step_dir):
+        raise OSError("torn read")
+
+    w = CommitWatcher(tmp_path, store, bad_load, poll_s=60.0)
+    _commit(tmp_path, 20, {"v": 20})
+    assert w.poll_once() is None  # swallowed, old params keep serving
+    assert store.get() == "old" and store.generation == 0
+    assert "torn read" in w.last_error
+
+
+def test_watcher_background_thread(tmp_path):
+    from sheeprl_tpu.checkpoint import wait_for_commit
+
+    _commit(tmp_path, 10, {"v": 10})
+    store = ParamStore("old", step=10)
+    w = CommitWatcher(tmp_path, store, lambda d: "new", poll_s=0.05)
+    w.start()
+    try:
+        _commit(tmp_path, 30, {"v": 30})
+        assert wait_for_commit(tmp_path, 10, timeout_s=5.0) is not None
+        deadline = 50
+        while store.generation == 0 and deadline:
+            deadline -= 1
+            import time
+
+            time.sleep(0.1)
+        assert store.generation == 1 and store.step == 30
+    finally:
+        w.stop()
+
+
+def test_wait_for_commit_times_out(tmp_path):
+    from sheeprl_tpu.checkpoint import wait_for_commit
+
+    assert wait_for_commit(tmp_path, 0, timeout_s=0.2, poll_s=0.05) is None

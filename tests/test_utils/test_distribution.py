@@ -124,12 +124,46 @@ def test_two_hot_distribution_mean_recovers_target():
     np.testing.assert_allclose(float(d.mean[0, 0]), target, rtol=1e-2)
 
 
-def test_two_hot_log_prob_peaks_at_target():
+def test_two_hot_log_prob_peaks_at_argmax_bin():
+    """``log_prob(x) = two_hot(symlog x) · log-softmax(logits)`` is a convex
+    interpolation between ADJACENT bin log-probs, so its global maximum over
+    x sits exactly on the encoded bin carrying the largest logit — NOT at
+    the distribution's mean: for a multimodal categorical the symexp-expected
+    value can land between low-probability bins, where the interpolated
+    log-prob is far below the peak.  (The old expectation here,
+    peak-at-mean, asserted exactly that and failed for random logits — the
+    math, not the implementation, was wrong.)"""
+    from sheeprl_tpu.utils.utils import symexp
+
     logits = jax.random.normal(KEY, (1, 255))
     d = TwoHotEncodingDistribution(logits)
-    lp_self = float(np.asarray(d.log_prob(d.mean)).reshape(-1)[0])
+    best = int(np.argmax(np.asarray(d.logits)[0]))
+    x_star = symexp(d.bins[best]).reshape(1, 1)
+    lp_star = float(np.asarray(d.log_prob(x_star)).reshape(-1)[0])
+    np.testing.assert_allclose(
+        lp_star, float(np.asarray(d.logits)[0, best]), rtol=1e-5
+    )  # the mode's log-prob IS the max logit
+    # ... and it dominates every other bin center (global max over the support)
+    d_all = TwoHotEncodingDistribution(jnp.tile(logits, (255, 1)))
+    lp_bins = np.asarray(d_all.log_prob(symexp(d_all.bins).reshape(255, 1))).reshape(-1)
+    assert lp_star >= lp_bins.max() - 1e-5
+    # ... including far outside the support (saturated top bucket)
+    lp_far = float(np.asarray(d.log_prob(d.mean + 1e6)).reshape(-1)[0])
+    assert lp_star > lp_far
+
+
+def test_two_hot_log_prob_peaks_at_target_when_mass_is_concentrated():
+    """When the categorical's mass IS concentrated on one value's two-hot
+    encoding, the log-prob peak does coincide with the mean — the shape the
+    old peak-at-mean expectation implicitly assumed."""
+    target = 3.7
+    d0 = TwoHotEncodingDistribution(jnp.zeros((1, 255)))
+    enc = d0._two_hot(jnp.array([[target]]))
+    d = TwoHotEncodingDistribution(jnp.log(enc + 1e-8))
+    lp_mean = float(np.asarray(d.log_prob(d.mean)).reshape(-1)[0])
     lp_far = float(np.asarray(d.log_prob(d.mean + 100.0)).reshape(-1)[0])
-    assert lp_self > lp_far
+    lp_near = float(np.asarray(d.log_prob(d.mean + 1.0)).reshape(-1)[0])
+    assert lp_mean > lp_near and lp_mean > lp_far
 
 
 def test_bernoulli_safe_mode():
