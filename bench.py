@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 BASELINE_DV3_UPDATES_PER_S = 0.5   # RTX 3080, MsPacman-100K (BASELINE.md)
@@ -47,19 +48,23 @@ def bench_dreamer_v3() -> dict:
     from sheeprl_tpu.parallel.fabric import build_fabric
 
     size = os.environ.get("BENCH_SIZE", "S")  # smoke-test hook (e.g. XS on CPU)
-    cfg = compose(
-        [
-            "exp=dreamer_v3",
-            "env=dummy",
-            "env.id=discrete_dummy",
-            f"algo=dreamer_v3_{size}",
-            "algo.cnn_keys.encoder=[rgb]",
-            "algo.mlp_keys.encoder=[]",
-            "algo.per_rank_batch_size=16",
-            "algo.per_rank_sequence_length=64",
-            "fabric.precision=bf16-mixed",
-        ]
-    )
+    overrides = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        f"algo=dreamer_v3_{size}",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[]",
+        "algo.per_rank_batch_size=16",
+        "algo.per_rank_sequence_length=64",
+        "fabric.precision=bf16-mixed",
+    ]
+    # BENCH_MESH='{data: 2, model: 4}': bench on a 2-D (data, model) mesh —
+    # the partition-rules sharding path (docs/sharding.md); the mesh shape is
+    # stamped into the JSON payload either way
+    if os.environ.get("BENCH_MESH"):
+        overrides.append(f"fabric.mesh_shape={os.environ['BENCH_MESH']}")
+    cfg = compose(overrides)
     fabric = build_fabric(cfg)
 
     # Build the jitted multi-update train phase exactly as the algorithm does,
@@ -108,7 +113,15 @@ def bench_dreamer_v3() -> dict:
     # The compile-vs-steady split is reported as SEPARATE JSON fields
     # (`first_call_s` / `steady_updates_per_s`) so the trajectory can tell a
     # compile-time regression from a math-throughput one.
+    # Two FLOPs-per-update estimates feed the MFU line:
+    # * XLA's own cost model for the compiled executable (exact for THIS
+    #   program, but per-shard under a model axis and backend-dependent);
+    # * the analytic param-tree estimate (_dv3_analytic_flops) — derived
+    #   from kernel shapes alone, so it is mesh-independent and always
+    #   available, including on the CPU fallback where MFU still must be
+    #   emitted (ISSUE 7 acceptance).
     flops_per_update = None
+    flops_analytic = _dv3_analytic_flops(params, B, L, int(cfg.algo.horizon))
     t_first = time.perf_counter()
     try:
         compiled = train_phase.compile_for(params, opt_state, block, key, jnp.int32(0))
@@ -116,7 +129,8 @@ def bench_dreamer_v3() -> dict:
         cost = compiled.cost_analysis()
         cost = cost[0] if isinstance(cost, (list, tuple)) else cost
         if cost and cost.get("flops"):
-            flops_per_update = float(cost["flops"]) / U
+            # per-shard flops under a model axis: scale to the whole mesh
+            flops_per_update = float(cost["flops"]) * len(fabric.devices) / U
     except Exception:
         pass  # cost analysis is best-effort; the throughput number still stands
 
@@ -162,19 +176,115 @@ def bench_dreamer_v3() -> dict:
         "steady_updates_per_s": round(updates_per_s, 3),
         "compile_executables": n_exe,
         "compile_time_s": round(compile_s, 3),
+        # utilization axis (ISSUE 7): mesh topology + FLOPs/update + MFU ride
+        # in every payload so BENCH_*.json tracks utilization across rounds.
+        # `mfu` uses XLA's cost model when available, `mfu_analytic` the
+        # param-tree estimate; both are null (but PRESENT) when the device's
+        # peak is unknown (CPU fallback) — override via SHEEPRL_PEAK_FLOPS.
+        "mesh_shape": {k: int(v) for k, v in fabric.mesh.shape.items()},
+        "flops_per_update": flops_per_update,
+        "flops_per_update_analytic": flops_analytic,
+        "mfu": None,
+        "mfu_analytic": None,
     }
-    if flops_per_update is not None:
-        result["flops_per_update"] = flops_per_update
-        peak = _peak_flops_per_s(dev)
-        if peak is not None:
-            result["mfu"] = round(flops_per_update * updates_per_s / peak, 4)
+    peak = _peak_flops_per_s(dev)
+    if peak is not None:
+        mesh_peak = peak * len(fabric.devices)
+        if flops_per_update is not None:
+            result["mfu"] = round(flops_per_update * updates_per_s / mesh_peak, 4)
+        result["mfu_analytic"] = round(flops_analytic * updates_per_s / mesh_peak, 4)
     return result
 
 
+def _dv3_analytic_flops(params, batch: int, seq_len: int, horizon: int) -> float:
+    """Analytic FLOPs per gradient update from the param tree alone.
+
+    Purpose: a mesh- and backend-independent MFU denominator that cannot
+    silently change when the compiled program does (the 8.8% -> >=25% claim
+    must be measured against a fixed cost model).  It is an independent
+    cross-check of XLA's per-executable count, not a replica of it: XLA
+    sees the post-optimization HLO (and its CPU cost model is known to
+    count convolutions differently), so the two can differ by ~2x on tiny
+    presets — `mfu` (XLA) is primary when the backend provides it,
+    `mfu_analytic` is the always-available, never-silently-changing one.
+
+    Cost model (per token, fwd = 2*prod(kernel) MACs; train = 3x fwd for
+    forward + both backward matmuls):
+
+    * world-model phase (encoder, RSSM scan, decoder, reward/continue
+      heads): every kernel trains on B*L sequence tokens;
+    * imagination phase: the RSSM dynamics (recurrent+transition) and the
+      actor roll `horizon` steps from B*L start states — the dynamics are
+      forward-only under DreamerV3's straight-through/REINFORCE estimator
+      (1x), the actor trains (3x);
+    * critic + target critic evaluate horizon+1 imagined states: critic
+      trains (3x), the EMA target is forward-only (1x).
+
+    Conv/deconv kernels are weighted by their spatial position count in the
+    64x64 stride-2 pyramid (conv_i at (32/2^i)^2 positions, deconv_i
+    mirrored, the final RGB deconv at 64^2); dense kernels count once per
+    token.
+    """
+    import re as _re
+
+    import jax
+    import numpy as _np
+    from jax.tree_util import tree_flatten_with_path
+
+    def kernel_fwd_flops(tree) -> float:
+        flat, _ = tree_flatten_with_path(tree)
+        total = 0.0
+        for kp, leaf in flat:
+            if getattr(leaf, "ndim", 0) < 2:
+                continue
+            path = "/".join(str(getattr(k, "key", getattr(k, "idx", getattr(k, "name", k)))) for k in kp)
+            macs = float(_np.prod(leaf.shape))
+            m = _re.search(r"(de)?conv_(\d+)|deconv_out", path)
+            if m and leaf.ndim == 4:
+                if "deconv_out" in path:
+                    positions = 64 * 64
+                elif m.group(1):  # deconv_i: 4x4 latent grid upsampled 2x per layer
+                    positions = (4 * 2 ** (int(m.group(2)) + 1)) ** 2
+                else:  # conv_i: 64x64 downsampled 2x per layer
+                    positions = (64 // 2 ** (int(m.group(2)) + 1)) ** 2
+                macs *= positions
+            total += 2.0 * macs
+        return total
+
+    p = params if isinstance(params, dict) else jax.device_get(params)
+    tokens = float(batch * seq_len)
+    wm = kernel_fwd_flops(p.get("world_model", {}))
+    actor = kernel_fwd_flops(p.get("actor", {}))
+    critic = kernel_fwd_flops(p.get("critic", {}))
+    target = kernel_fwd_flops(p.get("target_critic", {}))
+    dyn = kernel_fwd_flops(
+        {
+            k: v
+            for k, v in (p.get("world_model", {}).get("params", {}) or {}).items()
+            if k in ("recurrent_model", "transition_model")
+        }
+    )
+    return (
+        3.0 * tokens * wm
+        + tokens * horizon * (dyn + 3.0 * actor)
+        + tokens * (horizon + 1) * (3.0 * critic + target)
+    )
+
+
 def _peak_flops_per_s(dev) -> float | None:
-    """Peak bf16 FLOPs/s for known TPU generations (public spec sheets); None
-    when unknown (CPU fallback) so MFU is never reported against a made-up
-    denominator."""
+    """Peak bf16 FLOPs/s PER DEVICE for known TPU generations (public spec
+    sheets); None when unknown (CPU fallback) so MFU is never reported
+    against a made-up denominator.  ``SHEEPRL_PEAK_FLOPS`` overrides —
+    the hook for emitting a numeric MFU on hosts the table doesn't know."""
+    env = os.environ.get("SHEEPRL_PEAK_FLOPS", "").strip()
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            # a typo'd override must not throw away a finished multi-minute
+            # bench (and a raise here reads as an accelerator outage to the
+            # watchdog) — fall back to the device table
+            print(f"[bench] ignoring malformed SHEEPRL_PEAK_FLOPS={env!r}", file=sys.stderr)
     kind = getattr(dev, "device_kind", "").lower()
     table = {
         "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
@@ -205,9 +315,13 @@ def _build_dv3_train_phase(fabric, cfg):
 
     world_model, actor, critic, params = build_agent(fabric, (4,), False, cfg, obs_space)
     wm_opt, actor_opt, critic_opt, opt_state = build_dv3_optimizers(fabric, cfg, params)
+    # params/opt_state pin the partition-rules state shardings on the program
+    # exactly as the training loop does — the benchmarked program IS the
+    # training program, mesh topology included
     train_phase = dv3.make_train_phase(
         fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
         cnn_keys=("rgb",), mlp_keys=(), is_continuous=False,
+        params=params, opt_state=opt_state,
     )
     return train_phase, params, opt_state
 

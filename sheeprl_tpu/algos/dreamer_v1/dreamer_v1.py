@@ -27,7 +27,7 @@ from sheeprl_tpu.utils.utils import window_scan
 
 
 def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-                     cnn_keys, mlp_keys, is_continuous, p2e=None):
+                     cnn_keys, mlp_keys, is_continuous, p2e=None, params=None, opt_state=None):
     # ``p2e``: optional Plan2Explore hook {ens_module, ens_opt, n, multiplier}
     # — trains the forward-model ensembles alongside the world model and runs
     # TWO behavior updates per step: the exploration actor + its own critic on
@@ -240,10 +240,20 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
+    in_sh = out_sh = None
+    if params is not None and opt_state is not None:
+        from sheeprl_tpu.parallel.compile import state_io_shardings
+        from sheeprl_tpu.parallel.sharding import shardings_of
+
+        in_sh, out_sh = state_io_shardings(
+            shardings_of(params), shardings_of(opt_state), n_extra_in=3, n_extra_out=1
+        )
     return fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
         donate_argnums=(0, 1),
+        in_shardings=in_sh,
+        out_shardings=out_sh,
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
 

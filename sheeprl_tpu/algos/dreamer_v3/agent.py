@@ -596,14 +596,15 @@ def build_agent(
         bool(wm_cfg.recurrent_model.get("use_pallas", False))
         or bool(wm_cfg.recurrent_model.get("fused_pallas", False))
     ):
-        # tensor parallelism column-shards 2-D kernels over the model axis;
+        # the partition rules column-shard 2-D kernels over the model axis;
         # a pallas_call would receive a sharded w_gru operand — at best a
         # silent all-gather per step, at worst a Mosaic compile failure.
         # Enforce the howto/run_on_tpu.md exclusion instead of hoping (ADVICE r3)
         raise ValueError(
-            "tensor parallelism (fabric.model_parallel_size > 1) cannot be "
-            "combined with the Pallas RSSM kernels: param_sharding would "
-            "column-shard w_gru under the single-device pallas_call. Disable "
+            "tensor parallelism (fabric.mesh_shape with a model axis) cannot "
+            "be combined with the Pallas RSSM kernels: the partition rules "
+            "(docs/sharding.md) would shard the GRU kernel under the "
+            "single-device pallas_call. Disable "
             "algo.world_model.recurrent_model.{use_pallas,fused_pallas} or "
             "run without a model axis."
         )
@@ -658,6 +659,8 @@ def build_agent(
             "moments": {"low": jnp.zeros(()), "high": jnp.zeros(())},
         }
     # shard_params: replicated on a pure-data mesh; with fabric.mesh_shape
-    # declaring a model axis, large dense kernels (RSSM projections, actor/
-    # critic/head MLPs) are column-sharded over it (TP) — fabric.param_sharding
+    # declaring a model axis, placement follows the partition-rule tables of
+    # parallel/sharding.py (curated dreamer_v3 table under sharding.table=auto:
+    # RSSM dense stacks + GRU gates column-shard, decoder deconvs on output
+    # channels, MLP heads row-shard) — docs/sharding.md
     return world_model, actor, critic, fabric.shard_params(params)

@@ -228,6 +228,7 @@ def dreamer_family_loop(
     train_phase = make_train_phase_fn(
         fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
         cnn_keys=cnn_keys, mlp_keys=mlp_keys, is_continuous=is_continuous,
+        params=params, opt_state=opt_state,
     )
 
     # ---------------- replay buffer ------------------------------------------
@@ -579,10 +580,16 @@ def dreamer_family_loop(
 
 def make_train_phase(
     fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-    cnn_keys, mlp_keys, is_continuous,
+    cnn_keys, mlp_keys, is_continuous, params=None, opt_state=None,
 ):
     """Build the jitted multi-update train phase (shared with bench.py and
-    __graft_entry__.py so the benchmarked program IS the training program)."""
+    __graft_entry__.py so the benchmarked program IS the training program).
+
+    ``params``/``opt_state``: the already-placed state trees.  When given,
+    their partition-rules shardings are pinned as the program's in/out
+    shardings (``compile.state_io_shardings``) — combined with the argnum
+    0/1 donation this guarantees the optimizer state stays sharded exactly
+    like its params and both are updated in place across every window."""
     obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
@@ -835,9 +842,20 @@ def make_train_phase(
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
+    in_sh = out_sh = None
+    if params is not None and opt_state is not None:
+        from sheeprl_tpu.parallel.compile import state_io_shardings
+        from sheeprl_tpu.parallel.sharding import shardings_of
+
+        # train_phase(p, o_state, blocks, k, counter0) -> (p, o_state, metrics)
+        in_sh, out_sh = state_io_shardings(
+            shardings_of(params), shardings_of(opt_state), n_extra_in=3, n_extra_out=1
+        )
     return fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
         donate_argnums=(0, 1),
+        in_shardings=in_sh,
+        out_shardings=out_sh,
         max_recompiles=cfg.algo.get("max_recompiles"),
     )

@@ -88,7 +88,7 @@ def _ensemble(cfg, world_model):
 
 
 def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-                     cnn_keys, mlp_keys, is_continuous):
+                     cnn_keys, mlp_keys, is_continuous, params=None, opt_state=None):
     p2e = {
         "ens_module": _ensemble(cfg, world_model),
         "ens_opt": build_optimizer(cfg.algo.ensembles.optimizer, cfg.algo.ensembles.clip_gradients),
@@ -97,7 +97,7 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
     }
     return base_make_train_phase(
         fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-        cnn_keys, mlp_keys, is_continuous, p2e=p2e,
+        cnn_keys, mlp_keys, is_continuous, p2e=p2e, params=params, opt_state=opt_state,
     )
 
 

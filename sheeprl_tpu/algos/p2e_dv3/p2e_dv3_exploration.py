@@ -117,7 +117,7 @@ def ensemble_module(cfg):
 
 
 def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-                     cnn_keys, mlp_keys, is_continuous):
+                     cnn_keys, mlp_keys, is_continuous, params=None, opt_state=None):
     """DV3 world-model update + ensemble update + dual-critic exploration
     behavior + task behavior, scanned over the update block."""
 
@@ -436,10 +436,20 @@ def make_train_phase(fabric, cfg, world_model, actor, critic, wm_opt, actor_opt,
         )
         return p, o_state, jax.tree.map(lambda x: x.mean(), metrics)
 
+    in_sh = out_sh = None
+    if params is not None and opt_state is not None:
+        from sheeprl_tpu.parallel.compile import state_io_shardings
+        from sheeprl_tpu.parallel.sharding import shardings_of
+
+        in_sh, out_sh = state_io_shardings(
+            shardings_of(params), shardings_of(opt_state), n_extra_in=3, n_extra_out=1
+        )
     return fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
         donate_argnums=(0, 1),
+        in_shardings=in_sh,
+        out_shardings=out_sh,
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
 

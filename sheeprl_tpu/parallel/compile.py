@@ -344,6 +344,33 @@ class AOTFunction:
             return len(self._cache)
 
 
+def state_io_shardings(
+    param_shardings: Any,
+    opt_shardings: Any,
+    n_extra_in: int,
+    n_extra_out: int = 1,
+) -> Tuple[Tuple[Any, ...], Tuple[Any, ...]]:
+    """``(in_shardings, out_shardings)`` for the canonical train-phase
+    calling convention ``f(params, opt_state, *data) -> (params, opt_state,
+    *aux)`` shared by every algo's scanned update program.
+
+    ``param_shardings``/``opt_shardings`` are ``NamedSharding`` pytrees —
+    normally ``sharding.shardings_of(fabric.shard_params(...))``, i.e. the
+    partition-rules placement.  Pinning them on BOTH sides of the program
+    (and donating argnums 0/1 at the call site) is what makes a sharded
+    train step update params and optimizer state IN PLACE: the optimizer
+    moments keep exactly their params' column/row sharding across every
+    update, and XLA reuses the donated buffers instead of materializing a
+    gathered copy.  The ``None`` entries for data/key/counter arguments and
+    aux outputs mean 'unspecified' — jit infers those from the arguments
+    (the batch keeps its ``data``-axis sharding) and the computation.
+    """
+    return (
+        (param_shardings, opt_shardings) + (None,) * int(n_extra_in),
+        (param_shardings, opt_shardings) + (None,) * int(n_extra_out),
+    )
+
+
 def compile_once(
     fn: Callable,
     *,

@@ -131,9 +131,16 @@ class Fabric:
         callbacks: Optional[Dict[str, Any]] = None,
         mesh_shape: Optional[Dict[str, int]] = None,
         tp_min_param_size: int = 2**18,
+        sharding: Optional[Dict[str, Any]] = None,
     ):
         self.strategy = strategy
         self.tp_min_param_size = int(tp_min_param_size)
+        #: the ``sharding`` config group (rules table selection, user rules,
+        #: undivisible policy, explain flag); resolved lazily into a concrete
+        #: rule table by :attr:`sharding_rules`.  A bare ``Fabric(...)`` with
+        #: no config keeps the legacy size-threshold behavior.
+        self.sharding_cfg: Dict[str, Any] = dict(sharding or {})
+        self._sharding_rules: Optional[Tuple[Any, ...]] = None
         self.precision = Precision.from_string(precision)
         self.callbacks: List[Any] = []
         self._callback_cfg = callbacks or {}
@@ -278,7 +285,21 @@ class Fabric:
                 x = x.addressable_shards[0].data
             if isinstance(x, jax.Array) and x.committed and set(x.devices()) == {device}:
                 return x.copy()
-            return jax.device_put(x, device)
+            out = jax.device_put(x, device)
+            if (
+                isinstance(x, jax.Array)
+                and len(x.devices()) > 1
+                and next(iter(x.devices())).platform == device.platform
+            ):
+                # same-platform mesh → single device: device_put may be a
+                # ZERO-COPY alias of the shard already living on `device`
+                # (measured on jax 0.4.37 CPU).  The train step donates the
+                # source params, which would invalidate the player's "copy"
+                # mid-rollout — break the alias.  Cross-platform transfers
+                # (the production TPU→host pull) always materialize and skip
+                # this extra dispatch.
+                out = out.copy()
+            return out
 
         return jax.tree.map(put, tree)
 
@@ -330,6 +351,20 @@ class Fabric:
         def put(x: Any) -> Any:
             spec = [None] * np.ndim(x)
             if np.ndim(x) > axis:
+                # validate HERE, not in XLA: an indivisible batch used to
+                # surface as an opaque "sharding ... is not divisible" deep
+                # inside device_put/compile
+                if not multi_host:
+                    n = int(self.mesh.shape[self.data_axis])
+                    dim = int(np.shape(x)[axis])
+                    if dim % n != 0:
+                        raise ValueError(
+                            f"shard_batch: leaf of shape {np.shape(x)} cannot "
+                            f"shard axis {axis} ({dim} rows) over the "
+                            f"'{self.data_axis}' mesh axis ({n} devices); batch/"
+                            f"env counts must be multiples of the data-parallel "
+                            f"degree (mesh {dict(self.mesh.shape)})"
+                        )
                 spec[axis] = self.data_axis
             pspec = P(*spec)
             if multi_host:
@@ -353,18 +388,43 @@ class Fabric:
             return "model"
         return None
 
-    def param_sharding(self, tree: Any, min_size: Optional[int] = None) -> Any:
-        """Per-leaf shardings implementing the TP rule: 2-D kernels with
-        ``size >= tp_min_param_size`` whose output dim divides the ``model``
-        axis are column-sharded (Megatron-style partition of the weight's
-        output features); everything else — biases, LayerNorm params, conv
-        filters, scalars — is replicated.  GSPMD propagates the annotations
-        through the train step and inserts the matching collectives
-        (scaling-book recipe: annotate weights, let XLA place all-gathers).
+    @property
+    def sharding_rules(self) -> Tuple[Any, ...]:
+        """The resolved partition-rule table (``parallel/sharding.py``):
+        user ``sharding.rules`` overrides prepended to the selected base
+        table — the per-algo curated table under ``table: auto`` (DreamerV3
+        family: RSSM dense stacks, decoder deconvs, actor/critic MLPs), or
+        the legacy size-threshold fallback parameterized by the
+        ``tp_min_param_size`` compat knob."""
+        if self._sharding_rules is None:
+            from sheeprl_tpu.parallel.sharding import resolve_rules
+
+            self._sharding_rules = resolve_rules(
+                self.sharding_cfg, tp_min_param_size=self.tp_min_param_size
+            )
+        return self._sharding_rules
+
+    def param_sharding(
+        self, tree: Any, min_size: Optional[int] = None, rules: Optional[Any] = None
+    ) -> Any:
+        """Per-leaf ``NamedSharding``s for a param-shaped pytree, resolved
+        through :func:`sheeprl_tpu.parallel.sharding.match_partition_rules`
+        over :attr:`sharding_rules` (regex on tree path → ``PartitionSpec``,
+        first match wins, unmatched/scalar leaves replicate over the whole
+        mesh).  GSPMD propagates the annotations through the train step and
+        inserts the matching collectives (scaling-book recipe: annotate
+        weights, let XLA place the all-gathers/psums).
+
         With no ``model`` axis every leaf is replicated, so this is a strict
-        generalization of ``replicate``."""
+        generalization of ``replicate``.  Every produced spec is validated
+        against the mesh up front (axis exists, dims divide) — the
+        ``sharding.undivisible`` policy decides between a clear error and a
+        demotion to replicated; XLA never sees an unplaceable spec.
+
+        ``min_size`` is the ``tp_min_param_size`` compat hook: passing it
+        explicitly selects the legacy size-threshold table at that
+        threshold, bypassing the configured rules."""
         axis = self.model_axis
-        min_size = self.tp_min_param_size if min_size is None else min_size
         if axis is None:
             return jax.tree.map(lambda _: self.replicated, tree)
         if self.num_processes > 1:
@@ -378,24 +438,42 @@ class Fabric:
                 "currently single-controller only; multi-host runs must use a "
                 "pure data mesh (drop mesh_shape or set model: 1)"
             )
-        k = self.mesh.shape[axis]
+        from sheeprl_tpu.parallel import sharding as shd
 
-        def rule(x: Any) -> NamedSharding:
-            if (
-                getattr(x, "ndim", 0) == 2
-                and x.size >= min_size
-                and x.shape[-1] % k == 0
-            ):
-                return NamedSharding(self.mesh, P(None, axis))
-            return self.replicated
+        if rules is None:
+            rules = (
+                shd.size_threshold_rules(int(min_size))
+                if min_size is not None
+                else self.sharding_rules
+            )
+        undivisible = str(self.sharding_cfg.get("undivisible", "replicate"))
+        specs = shd.partition_specs(rules, tree, self.mesh, undivisible=undivisible)
+        if self.sharding_cfg.get("explain"):
+            self.print(shd.explain(rules, tree, self.mesh, undivisible=undivisible))
+        return shd.named_sharding_tree(self.mesh, specs)
 
-        return jax.tree.map(rule, tree)
-
-    def shard_params(self, tree: Any, min_size: Optional[int] = None) -> Any:
+    def shard_params(
+        self, tree: Any, min_size: Optional[int] = None, rules: Optional[Any] = None
+    ) -> Any:
         """Place a param-shaped pytree per ``param_sharding``.  Also correct
-        for optimizer states: Adam/RMSProp moments share the kernels' shapes,
-        so the same rule shards them consistently with their params."""
-        return jax.device_put(tree, self.param_sharding(tree, min_size))
+        for optimizer states: Adam/RMSProp moments live under tree paths
+        containing the same module/kernel suffix their params do, so the
+        same regex rules place them consistently with their params."""
+        return jax.device_put(tree, self.param_sharding(tree, min_size, rules))
+
+    def explain_sharding(self, tree: Any, title: str = "partition rules") -> str:
+        """Human-readable resolved spec per leaf (``sharding.explain`` and
+        interactive debugging): which rule matched, what got demoted, what
+        stays replicated."""
+        from sheeprl_tpu.parallel import sharding as shd
+
+        return shd.explain(
+            self.sharding_rules,
+            tree,
+            self.mesh,
+            undivisible=str(self.sharding_cfg.get("undivisible", "replicate")),
+            title=title,
+        )
 
     def setup_module(self, tree: Any) -> Any:  # reference-API parity alias
         return self.replicate(tree)
@@ -433,7 +511,14 @@ class Fabric:
         returns an :class:`~sheeprl_tpu.parallel.compile.AOTFunction` whose
         executables are AOT-lowered/compiled per abstract signature, counted
         in the recompile detector, and warmable from :attr:`compile_pool`.
-        Drop-in replacement for decorating ``fn`` with ``jax.jit``."""
+        Drop-in replacement for decorating ``fn`` with ``jax.jit``.
+
+        ``in_shardings``/``out_shardings`` take ``NamedSharding`` pytrees
+        (``None`` entries = unspecified).  Train phases pass their param and
+        opt-state sharding trees on both sides plus ``donate_argnums`` so the
+        partition-rules placement is pinned across updates and the state is
+        updated in place — build the tuples with
+        :func:`sheeprl_tpu.parallel.compile.state_io_shardings`."""
         from sheeprl_tpu.parallel.compile import compile_once
 
         return compile_once(
@@ -791,6 +876,21 @@ def build_fabric(cfg: Any) -> Fabric:
                 reset_cache()
             except Exception:
                 pass
+    if "tp_min_param_size" in fab_cfg:
+        import warnings
+
+        warnings.warn(
+            "fabric.tp_min_param_size is deprecated: parameter placement is "
+            "now decided by the sharding rules engine (sharding.rules / "
+            "sharding.table, see docs/sharding.md). The knob still "
+            "parameterizes the legacy 'size_threshold' fallback table only.",
+            DeprecationWarning,
+            stacklevel=2,
+        )
+    # the sharding config group travels with the algo name so `table: auto`
+    # can resolve the curated per-algo rule table at first use
+    sharding_cfg = dict(cfg.get("sharding") or {})
+    sharding_cfg.setdefault("algo", (cfg.get("algo") or {}).get("name"))
     fabric = Fabric(
         devices=fab_cfg.get("devices", 1),
         num_nodes=fab_cfg.get("num_nodes", 1),
@@ -800,6 +900,7 @@ def build_fabric(cfg: Any) -> Fabric:
         callbacks=fab_cfg.get("callbacks", {}),
         mesh_shape=fab_cfg.get("mesh_shape", None),
         tp_min_param_size=fab_cfg.get("tp_min_param_size", 2**18),
+        sharding=sharding_cfg,
     )
     cb_cfg = fab_cfg.get("callbacks", {}) or {}
     if "checkpoint" in cb_cfg:
@@ -846,6 +947,8 @@ def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
     sub.mesh = Mesh(np.asarray(trainer_devices), ("data",))
     sub.data_axis = "data"
     sub.tp_min_param_size = fabric.tp_min_param_size
+    sub.sharding_cfg = dict(fabric.sharding_cfg)
+    sub._sharding_rules = None
     sub.checkpoint_manager = fabric.checkpoint_manager
     return sub
 
@@ -865,6 +968,9 @@ def get_single_device_fabric(fabric: Fabric, device: Optional[Any] = None) -> Fa
     single.accelerator = fabric.accelerator
     single.mesh = Mesh(np.asarray([device]), ("data",))
     single.data_axis = "data"
+    single.tp_min_param_size = fabric.tp_min_param_size
+    single.sharding_cfg = dict(fabric.sharding_cfg)
+    single._sharding_rules = None
     single.checkpoint_manager = None
     return single
 

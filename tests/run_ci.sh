@@ -8,19 +8,48 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/5: unit + E2E dry-run suite ==="
+echo "=== stage 1/6: unit + E2E dry-run suite ==="
 python -m pytest tests/ -x -q --ignore=tests/test_regression --ignore=tests/test_checkpoint
 
-echo "=== stage 2/5: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
+echo "=== stage 2/6: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
 python -m pytest tests/test_checkpoint -q
 
-echo "=== stage 3/5: numeric regression (goldens + reference fixture) ==="
+echo "=== stage 3/6: numeric regression (goldens + reference fixture) ==="
 python -m pytest tests/test_regression -q
 
-echo "=== stage 4/5: multichip dryrun (virtual 8-device mesh) ==="
+echo "=== stage 4/6: multichip dryrun (virtual 8-device mesh) ==="
 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "=== stage 5/5: policy-serving smoke (HTTP server + batched requests + clean shutdown) ==="
+echo "=== stage 5/6: 2-D (data x model) mesh training cell + compile budget ==="
+# dreamer_v3 end-to-end through the CLI on a 2x4 fake-device mesh: the
+# partition-rules (TP) path with the recompile detector as a hard gate —
+# algo.max_recompiles=1 means each compile-once program (train phase, player
+# step) may compile at most twice (first compile free + the prefill/train
+# signature split); a TP path that regressed to recompile-per-step dies here.
+python - <<'PY'
+from sheeprl_tpu.cli import run
+run([
+    "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy",
+    "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[]",
+    "algo.horizon=4", "algo.dense_units=16", "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.world_model.recurrent_model.recurrent_state_size=32",
+    "algo.world_model.transition_model.hidden_size=32",
+    "algo.world_model.representation_model.hidden_size=32",
+    "algo.world_model.discrete_size=4", "algo.world_model.stochastic_size=4",
+    "algo.per_rank_batch_size=4", "algo.per_rank_sequence_length=8",
+    "algo.learning_starts=16", "algo.total_steps=32", "algo.replay_ratio=0.5",
+    "algo.max_recompiles=1", "algo.run_test=False",
+    "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+    "fabric.devices=8", "fabric.accelerator=cpu",
+    "fabric.mesh_shape={data: 2, model: 4}",
+    "checkpoint.every=0", "checkpoint.save_last=False", "buffer.memmap=False",
+    "metric.log_level=0", "log_dir=/tmp/run_ci_tp_logs", "print_config=False",
+])
+print("stage 5/6 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
+PY
+
+echo "=== stage 6/6: policy-serving smoke (HTTP server + batched requests + clean shutdown) ==="
 python tests/serve_smoke.py
 
 echo "CI gate: ALL GREEN"

@@ -254,3 +254,26 @@ def test_packed_copy_preserves_weak_type():
     got = _packed_copy(leaves, jax.devices()[0])
     assert got[2].weak_type, "packed copy must not strip weak_type"
     assert not got[0].weak_type
+
+
+def test_copy_to_survives_source_donation_on_same_platform_mesh():
+    """The player-refresh pull must be a REAL copy even when the mesh and
+    the player device share a platform: jax.device_put of a replicated
+    multi-device array onto one of its own devices can be a zero-copy
+    alias (jax 0.4.37 CPU), and the train step DONATES the source params —
+    an aliased player copy would die mid-rollout with 'buffer has been
+    deleted or donated'.  (Cross-platform TPU→host pulls always
+    materialize, which is why real-chip runs never saw this.)"""
+    from sheeprl_tpu.parallel.fabric import Fabric
+
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": 2, "model": 4})
+    params = fab.shard_params(
+        {"kernel": jnp.ones((16, 8)), "bias": jnp.arange(4.0)}
+    )
+    host_copy = fab.copy_to(params, fab.host_device)
+    jax.block_until_ready(host_copy)
+    for leaf in jax.tree.leaves(params):
+        leaf.delete()  # what donation does to the source tree
+    for leaf in jax.tree.leaves(host_copy):
+        np.asarray(leaf)  # must still be readable
+    np.testing.assert_array_equal(np.asarray(host_copy["bias"]), np.arange(4.0))

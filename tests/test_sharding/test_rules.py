@@ -1,0 +1,199 @@
+"""Partition-rules engine units: ordering, first-match-wins, no-match →
+replicated, mesh validation, config resolution, explain()."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from sheeprl_tpu.parallel import sharding as shd
+from sheeprl_tpu.parallel.fabric import Fabric
+
+
+@pytest.fixture()
+def mesh24():
+    devs = np.asarray(jax.devices("cpu")[:8]).reshape(2, 4)
+    return Mesh(devs, ("data", "model"))
+
+
+def test_first_match_wins_ordering(mesh24):
+    tree = {"block": {"kernel": jnp.zeros((8, 8))}}
+    # the specific rule shadows the generic one when listed first...
+    specs = shd.match_partition_rules(
+        [(r"block/kernel", P("model", None)), (r"kernel", P(None, "model"))], tree
+    )
+    assert specs["block"]["kernel"] == P("model", None)
+    # ...and is shadowed when listed second
+    specs = shd.match_partition_rules(
+        [(r"kernel", P(None, "model")), (r"block/kernel", P("model", None))], tree
+    )
+    assert specs["block"]["kernel"] == P(None, "model")
+
+
+def test_no_match_and_scalars_replicate(mesh24):
+    tree = {"bias": jnp.zeros((8,)), "count": jnp.zeros(()), "w": jnp.zeros((8, 8))}
+    specs = shd.match_partition_rules([(r"w$", P(None, "model"))], tree)
+    assert specs["bias"] == P()
+    assert specs["count"] == P()
+    assert specs["w"] == P(None, "model")
+
+
+def test_callable_rule_fallthrough(mesh24):
+    def only_big(path, leaf, mesh):
+        return P(None, "model") if leaf.size >= 64 else None
+
+    rules = [(r".*", only_big), (r"small", P("data", None))]
+    tree = {"big": jnp.zeros((8, 8)), "small": jnp.zeros((4, 4))}
+    specs = shd.match_partition_rules(rules, tree, mesh24)
+    assert specs["big"] == P(None, "model")
+    # the callable declined -> the NEXT rule still gets a chance
+    assert specs["small"] == P("data", None)
+
+
+def test_opt_state_paths_match_param_rules(mesh24):
+    """Adam moments carry the kernel path suffix → same spec as the param."""
+    import optax
+
+    params = {"trunk": {"dense_0": {"kernel": jnp.zeros((16, 8)), "bias": jnp.zeros((8,))}}}
+    opt_state = optax.adam(1e-3).init(params)
+    rules = [(r"dense_[0-9]+/kernel", P(None, "model"))]
+    pspec = shd.match_partition_rules(rules, params)
+    ospec = shd.match_partition_rules(rules, opt_state)
+    assert pspec["trunk"]["dense_0"]["kernel"] == P(None, "model")
+    flat_o, _ = shd.tree_paths_and_leaves(ospec)
+    kernel_specs = [s for p, s in flat_o if p.endswith("dense_0/kernel")]
+    assert kernel_specs and all(s == P(None, "model") for s in kernel_specs)
+    bias_specs = [s for p, s in flat_o if p.endswith("dense_0/bias")]
+    assert bias_specs and all(s == P() for s in bias_specs)
+
+
+def test_validation_unknown_axis_always_raises(mesh24):
+    with pytest.raises(ValueError, match="not in mesh axes"):
+        shd.partition_specs(
+            [(r"w", P(None, "expert"))], {"w": jnp.zeros((8, 8))}, mesh24
+        )
+
+
+def test_validation_undivisible_policies(mesh24):
+    tree = {"w": jnp.zeros((8, 6))}  # 6 % 4 != 0
+    rules = [(r"w", P(None, "model"))]
+    specs = shd.partition_specs(rules, tree, mesh24, undivisible="replicate")
+    assert specs["w"] == P()
+    with pytest.raises(ValueError, match="cannot tile"):
+        shd.partition_specs(rules, tree, mesh24, undivisible="error")
+
+
+def test_unmatched_leaves_fully_replicated_on_2d_mesh(mesh24):
+    """Satellite check: a small unmatched leaf must land fully replicated
+    across the MODEL axis too, not just data — every device holds it."""
+    sh = shd.named_sharding_tree(
+        mesh24, shd.partition_specs((), {"b": jnp.zeros((3,))}, mesh24)
+    )
+    x = jax.device_put(jnp.arange(3.0), sh["b"])
+    assert x.sharding.is_fully_replicated
+    assert len(x.devices()) == 8
+
+
+def test_dreamer_v3_table_placements(mesh24):
+    tree = {
+        "world_model": {"params": {
+            "recurrent_model": {"gru": {"fused": {"kernel": jnp.zeros((64, 96))}},
+                                "in": {"kernel": jnp.zeros((20, 32))},
+                                "ln": {"LayerNorm_0": {"scale": jnp.zeros((32,))}}},
+            "observation_model": {"cnn_in": {"kernel": jnp.zeros((48, 256))},
+                                  "deconv_0": {"kernel": jnp.zeros((4, 4, 16, 8))},
+                                  "deconv_out": {"kernel": jnp.zeros((4, 4, 8, 3))},
+                                  "head_state": {"kernel": jnp.zeros((32, 7))}},
+            "encoder": {"conv_0": {"kernel": jnp.zeros((4, 4, 3, 8))}},
+            "initial_recurrent": jnp.zeros((32,)),
+        }},
+        "actor": {"params": {"trunk": {"dense_0": {"kernel": jnp.zeros((48, 32))}},
+                             "head": {"kernel": jnp.zeros((32, 4))}}},
+    }
+    specs = shd.partition_specs(shd.DREAMER_V3_RULES, tree, mesh24)
+    wm = specs["world_model"]["params"]
+    assert wm["recurrent_model"]["gru"]["fused"]["kernel"] == P(None, "model")
+    assert wm["recurrent_model"]["in"]["kernel"] == P(None, "model")
+    assert wm["recurrent_model"]["ln"]["LayerNorm_0"]["scale"] == P()
+    assert wm["observation_model"]["cnn_in"]["kernel"] == P(None, "model")
+    assert wm["observation_model"]["deconv_0"]["kernel"] == P(None, None, None, "model")
+    # RGB output head (3 channels) pinned replicated BEFORE the deconv rule
+    assert wm["observation_model"]["deconv_out"]["kernel"] == P()
+    # per-key obs head row-shards (7 outputs never divide; 32 inputs do)
+    assert wm["observation_model"]["head_state"]["kernel"] == P("model", None)
+    assert wm["encoder"]["conv_0"]["kernel"] == P(None, None, None, "model")
+    assert wm["initial_recurrent"] == P()
+    assert specs["actor"]["params"]["trunk"]["dense_0"]["kernel"] == P(None, "model")
+    assert specs["actor"]["params"]["head"]["kernel"] == P("model", None)
+
+
+def test_resolve_rules_user_rules_prepended():
+    rules = shd.resolve_rules(
+        {"table": "dreamer_v3", "rules": [["actor/.*kernel", [None, "model"]]]}
+    )
+    assert rules[0][0] == "actor/.*kernel"
+    assert rules[0][1] == P(None, "model")
+    assert rules[1:] == shd.DREAMER_V3_RULES
+    # the user rule now wins over the table's head rule for actor kernels
+    spec, label = shd._match_one(rules, "actor/params/head/kernel", jnp.zeros((8, 8)), None)
+    assert spec == P(None, "model") and label == "actor/.*kernel"
+
+
+def test_resolve_rules_tables():
+    assert shd.resolve_rules({"table": "auto", "algo": "dreamer_v3"}) == shd.DREAMER_V3_RULES
+    assert shd.resolve_rules({"table": "auto", "algo": "p2e_dv3"}) == shd.DREAMER_V3_RULES
+    # no curated table -> size-threshold fallback (one callable catch-all)
+    auto = shd.resolve_rules({"table": "auto", "algo": "ppo"}, tp_min_param_size=128)
+    assert len(auto) == 1 and callable(auto[0][1])
+    assert shd.resolve_rules({"table": "replicate"}) == ()
+    with pytest.raises(ValueError, match="Unknown sharding table"):
+        shd.resolve_rules({"table": "nope"})
+
+
+def test_size_threshold_table_matches_legacy_fabric_rule(mesh24):
+    """The retired fabric.py ad-hoc rule and its rules-table port place
+    every leaf identically (including the divisibility fallback)."""
+    rules = shd.size_threshold_rules(64)
+    tree = {
+        "kernel": jnp.zeros((16, 8)),   # big enough, divides -> sharded
+        "bias": jnp.zeros((8,)),        # 1-D -> replicated
+        "small": jnp.zeros((4, 4)),     # below threshold -> replicated
+        "odd": jnp.zeros((16, 7)),      # 7 % 4 -> replicated (legacy fallback)
+    }
+    specs = shd.partition_specs(rules, tree, mesh24)
+    assert specs["kernel"] == P(None, "model")
+    assert specs["bias"] == specs["small"] == specs["odd"] == P()
+
+
+def test_explain_reports_rule_and_demotion(mesh24):
+    tree = {"w": jnp.zeros((8, 8)), "odd": jnp.zeros((8, 6)), "b": jnp.zeros((4,))}
+    text = shd.explain(
+        [(r"w|odd", P(None, "model"))], tree, mesh24, undivisible="replicate"
+    )
+    assert "3 leaves, 1 sharded, 1 demoted" in text
+    assert "<unmatched>" in text          # b
+    assert "does not divide" in text      # odd's demotion reason
+
+
+def test_fabric_explain_sharding_smoke():
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": 2, "model": 4},
+                 sharding={"table": "dreamer_v3"})
+    text = fab.explain_sharding({"actor": {"params": {"head": {"kernel": jnp.zeros((32, 4))}}}})
+    assert "head" in text and "model" in text
+
+
+def test_shard_batch_divisibility_assertion():
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": 2, "model": 4})
+    # batch divides the DATA axis only (2), not the whole mesh: fine
+    out = fab.shard_batch({"x": jnp.zeros((6, 3))}, axis=0)
+    assert out["x"].sharding.spec == P("data", None)
+    with pytest.raises(ValueError, match="shard_batch"):
+        fab.shard_batch({"x": jnp.zeros((3, 5))}, axis=0)
+
+
+def test_spec_from_config_forms():
+    assert shd.spec_from_config(None) == P()
+    assert shd.spec_from_config("model") == P("model")
+    assert shd.spec_from_config([None, "model"]) == P(None, "model")
+    assert shd.spec_from_config([["data", "model"], None]) == P(("data", "model"), None)
