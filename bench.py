@@ -482,10 +482,136 @@ def bench_serve() -> dict:
     }
 
 
+def bench_fault_overhead() -> dict:
+    """Zero-overhead gate for the fault-injection layer (docs/resilience.md).
+
+    The engine's contract is that an EMPTY fault plan compiles to a no-op
+    (the process-global plan is ``None`` and every instrumented site is a
+    single pointer test).  This bench holds it to the number the ISSUE
+    names: steady-state DreamerV3 updates/s with fault injection installed-
+    but-empty must be within ``BENCH_FAULT_TOL`` (default 2%) of the
+    uninstrumented baseline — measured as INTERLEAVED A/B windows over the
+    same compiled executable so host noise hits both arms alike — and the
+    empty-plan run must emit zero ``Resilience/*`` metrics.
+
+    ``gate_failed: true`` in the payload (and a nonzero exit) on violation.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.resilience.faults import FaultPlan, clear_plan, fault_point, install_plan
+    from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+    from sheeprl_tpu.utils.utils import device_sync
+
+    size = os.environ.get("BENCH_SIZE", "XS")
+    L = int(os.environ.get("BENCH_L", 8))
+    B = int(os.environ.get("BENCH_B", 4))
+    U = int(os.environ.get("BENCH_U", 2))
+    samples = int(os.environ.get("BENCH_FAULT_SAMPLES", 12))
+    tol = float(os.environ.get("BENCH_FAULT_TOL", 0.02))
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"algo=dreamer_v3_{size}",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.per_rank_batch_size={B}",
+            f"algo.per_rank_sequence_length={L}",
+        ]
+    )
+    fabric = build_fabric(cfg)
+    rng = np.random.default_rng(0)
+    block = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(np.uint8)),
+        "actions": jnp.asarray(rng.integers(0, 2, (U, L, B, 4)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(U, L, B)).astype(np.float32)),
+        "terminated": jnp.zeros((U, L, B), jnp.float32),
+        "is_first": jnp.zeros((U, L, B), jnp.float32),
+    }
+    train_phase, params, opt_state = _build_dv3_train_phase(fabric, cfg)
+    block = fabric.shard_batch(block, axis=2)
+    key = jax.random.PRNGKey(0)
+
+    # warm up once; both arms reuse this one executable
+    params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(0))
+    device_sync((params, metrics))
+
+    RESILIENCE_MONITOR.reset()
+
+    step = 0
+
+    def one_dispatch(hooked: bool):
+        nonlocal params, opt_state, step
+        t0 = time.perf_counter()
+        if hooked:
+            # the instrumented arm must HIT a real site or the gate is
+            # vacuous: real train iterations poll fabric.copy_to (player
+            # sync) once per iteration, so pay the same hook here.  The
+            # baseline arm deliberately does NOT call it — a regression of
+            # the disabled fast path must show up as a DIFFERENCE, not
+            # cancel out across both arms.
+            fault_point("fabric.copy_to")
+        params, opt_state, metrics = train_phase(
+            params, opt_state, block, key, jnp.int32(step)
+        )
+        device_sync((params, metrics))
+        step += 1
+        return time.perf_counter() - t0
+
+    one_dispatch(False)  # discard one warm-in dispatch (caches, allocator)
+
+    # Estimator chosen for a noisy shared host: a dispatch only ever gets
+    # SLOWED by contention (noise is strictly one-sided), so each arm's
+    # MIN-of-N dispatch time is a tight estimate of its attainable latency;
+    # arms alternate per dispatch so drift cannot systematically favor one.
+    baseline, empty_plan = [], []
+    for s in range(2 * samples):
+        if s % 2 == 0:
+            clear_plan()  # fault injection entirely absent, no hook called
+            baseline.append(one_dispatch(False))
+        else:
+            # the user-facing "enabled with an empty plan" spelling —
+            # install_plan MUST fold it to None (the zero-overhead contract)
+            install_plan(FaultPlan.from_specs([]))
+            empty_plan.append(one_dispatch(True))
+    clear_plan()
+
+    base = U / min(baseline)  # attainable updates/s, no fault layer
+    empty = U / min(empty_plan)  # …with an installed-but-empty plan
+    # directional: only a SLOWDOWN of the empty-plan arm is a regression —
+    # the arms run near-identical code, so "empty came out faster" is noise
+    # and must not fail CI
+    overhead = max(0.0, (base - empty) / base)
+    leaked = RESILIENCE_MONITOR.metrics()  # must be {} — nothing recorded
+    gate_failed = overhead >= tol or bool(leaked)
+    return {
+        "metric": (
+            f"fault_injection_empty_plan_overhead "
+            f"(dreamer_v3_{size} B={B} L={L} U={U}, {samples}x interleaved A/B, min-estimator)"
+        ),
+        "value": round(overhead * 100, 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "steady_updates_per_s_no_plan": round(base, 4),
+        "steady_updates_per_s_empty_plan": round(empty, 4),
+        "tolerance_pct": tol * 100,
+        "resilience_metrics_emitted": leaked,
+        "gate_failed": gate_failed,
+    }
+
+
 def _run_bench() -> dict:
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
     if target == "serve":
         return bench_serve()
+    if target == "fault_overhead":
+        return bench_fault_overhead()
     if target in BASELINE_CPU_WALL_CLOCK_S:
         return bench_cpu_wall_clock(target)
     return bench_dreamer_v3()
@@ -607,6 +733,11 @@ if __name__ == "__main__":
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             # the TPU plugin overrides the env var; jax.config wins
             force_cpu_backend()
-        print(json.dumps(_run_bench()))
+        result = _run_bench()
+        print(json.dumps(result))
+        if result.get("gate_failed"):
+            # the fault-overhead gate is an ASSERTION: empty-plan steady
+            # state drifted beyond tolerance (or Resilience/* leaked)
+            sys.exit(1)
     else:
         _watchdog_main()

@@ -21,8 +21,10 @@ from sheeprl_tpu.checkpoint.protocol import (
     latest_checkpoint,
     list_checkpoints,
     newer_checkpoint,
+    quarantine_checkpoint,
     read_manifest,
     verify_checkpoint,
+    verify_or_quarantine,
     wait_for_commit,
 )
 from sheeprl_tpu.checkpoint.serialize import (
@@ -53,11 +55,13 @@ __all__ = [
     "load_checkpoint",
     "newer_checkpoint",
     "preemption_requested",
+    "quarantine_checkpoint",
     "read_manifest",
     "resolve_auto_resume",
     "save_checkpoint",
     "snapshot_tree",
     "to_host_tree",
     "verify_checkpoint",
+    "verify_or_quarantine",
     "wait_for_commit",
 ]

@@ -53,6 +53,9 @@ class CheckpointManager:
         self.async_save = bool(ckpt_cfg.get("async_save", True))
         self.queue_size = int(ckpt_cfg.get("queue_size", 2) or 2)
         self.commit_timeout_s = float(ckpt_cfg.get("commit_timeout_s", 300.0))
+        self.io_retries = int(ckpt_cfg.get("io_retries", 3) or 1)
+        self.io_retry_base_s = float(ckpt_cfg.get("io_retry_base_s", 0.5))
+        self.hang_warn_s = float(ckpt_cfg.get("hang_warn_s", 120.0) or 0)
         self.preemption_poll_every = int(ckpt_cfg.get("preemption_poll_every", 10) or 10)
         self.save_on_preemption = bool(ckpt_cfg.get("save_on_preemption", True))
         self.root = Path(log_dir) / "checkpoint"
@@ -158,8 +161,12 @@ class CheckpointManager:
             # the inline job on the same rank: drain first
             if self._writer is not None:
                 self._writer.flush()
+            from sheeprl_tpu.checkpoint.writer import run_with_io_retry
+
             t0 = time.perf_counter()
-            nbytes = job()
+            # same transient-IO tolerance as the async writer: a preemption
+            # final save racing a flaky disk should not lose the run
+            nbytes = run_with_io_retry(job, self.io_retries, self.io_retry_base_s)
             CHECKPOINT_MONITOR.record_save(
                 seconds=time.perf_counter() - t0, nbytes=nbytes, asynchronous=False
             )
@@ -168,7 +175,12 @@ class CheckpointManager:
             self.fabric.barrier()
         else:
             if self._writer is None:
-                self._writer = AsyncCheckpointWriter(queue_size=self.queue_size)
+                self._writer = AsyncCheckpointWriter(
+                    queue_size=self.queue_size,
+                    io_retries=self.io_retries,
+                    io_retry_base_s=self.io_retry_base_s,
+                    hang_warn_s=self.hang_warn_s,
+                )
             self._writer.submit(job)
         return step_dir
 
@@ -188,7 +200,9 @@ class CheckpointManager:
 
 
 def resolve_auto_resume(
-    base: Union[str, os.PathLike], root_dir: Union[str, os.PathLike]
+    base: Union[str, os.PathLike],
+    root_dir: Union[str, os.PathLike],
+    exclude: Any = (),
 ) -> Optional[Path]:
     """``checkpoint.resume_from=auto``: newest committed snapshot across
     every run/version under ``<base>/<root_dir>`` (run names are usually
@@ -197,11 +211,17 @@ def resolve_auto_resume(
     from unrelated restarts of the same experiment are not comparable."""
     import glob
 
+    from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+
     root = os.path.join(os.fspath(base), os.fspath(root_dir))
     best: Optional[Path] = None
     best_mtime = -1.0
     for ckpt_root in glob.glob(os.path.join(root, "*", "version_*", "checkpoint")):
         for step_dir in map(Path, glob.glob(os.path.join(ckpt_root, "step_*"))):
+            if checkpoint_step(step_dir) < 0:
+                continue  # quarantined (step_*.corrupt) snapshots are out
+            if step_dir in exclude:
+                continue  # known-damaged but un-renameable (read-only store)
             commit = step_dir / "COMMIT"
             try:
                 mtime = commit.stat().st_mtime

@@ -75,10 +75,17 @@ def write_shard(
 ) -> Dict[str, int]:
     """Durably write one rank's shard + its meta sidecar.  The meta file is
     written AFTER the shard, so its presence implies a complete shard."""
+    from sheeprl_tpu.resilience.faults import fault_bytes
+
     step_dir = Path(step_dir)
     payload, crc = dump_bytes(host_state)
-    durable_write(step_dir / shard_name(rank), payload)
+    # chaos-drill injection site: raise/hang simulates a dying disk, while
+    # corrupt/truncate damages the payload AFTER the CRC was taken — exactly
+    # the bit-rotted/short shard verify_checkpoint must catch downstream
+    # (the meta keeps the intended size/CRC, as a real torn write would)
     meta = {"crc32": crc, "bytes": len(payload)}
+    payload = fault_bytes("checkpoint.write_shard", payload)
+    durable_write(step_dir / shard_name(rank), payload)
     durable_write(step_dir / _meta_name(rank), json.dumps(meta).encode())
     return meta
 
@@ -114,10 +121,16 @@ def write_commit(
     """Rank 0's side of the protocol: wait for all shards, write the CRC
     manifest, then the ``COMMIT`` marker.  Returns False on shard timeout
     (snapshot left uncommitted — never eligible for resume)."""
+    from sheeprl_tpu.resilience.faults import fault_point
+
     step_dir = Path(step_dir)
     shards = wait_for_shards(step_dir, world, timeout_s)
     if shards is None:
         return False
+    # chaos-drill injection site: a crash/hang HERE (after the shards, before
+    # the COMMIT marker) is the canonical torn snapshot — it must stay
+    # invisible to resume/serve forever
+    fault_point("checkpoint.commit")
     manifest = {
         "step": int(step),
         "world": int(world),
@@ -161,6 +174,52 @@ def verify_checkpoint(step_dir: Union[str, os.PathLike]) -> List[str]:
             problems.append(f"{name}: {len(data)} bytes, manifest says {meta['bytes']}")
         elif (zlib.crc32(data) & 0xFFFFFFFF) != meta["crc32"]:
             problems.append(f"{name}: CRC mismatch")
+    return problems
+
+
+CORRUPT_SUFFIX = ".corrupt"
+
+
+def quarantine_checkpoint(step_dir: Union[str, os.PathLike]) -> Optional[Path]:
+    """Atomically rename a damaged COMMITTED snapshot out of the discovery
+    namespace: ``step_000…N`` → ``step_000…N.corrupt`` (the suffix makes
+    :func:`checkpoint_step` return -1, so ``list_checkpoints`` /
+    ``latest_checkpoint`` / ``newer_checkpoint`` — and through them
+    ``resume_from=auto`` and the serving loader/watcher — simply never see
+    it again).  The data is kept for forensics, not deleted.  Returns the
+    quarantine path, or None when the snapshot vanished concurrently (e.g.
+    a racing ``gc_checkpoints``) or the rename failed."""
+    from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+
+    step_dir = Path(step_dir)
+    target = step_dir.with_name(step_dir.name + CORRUPT_SUFFIX)
+    if target.exists():  # quarantined twice (concurrent verifiers)
+        suffix = 1
+        while target.exists():
+            target = step_dir.with_name(f"{step_dir.name}{CORRUPT_SUFFIX}.{suffix}")
+            suffix += 1
+    try:
+        os.replace(step_dir, target)
+    except OSError:
+        return None
+    try:
+        fsync_dir(step_dir.parent)
+    except OSError:
+        pass
+    RESILIENCE_MONITOR.record_quarantine(target)
+    return target
+
+
+def verify_or_quarantine(step_dir: Union[str, os.PathLike]) -> List[str]:
+    """:func:`verify_checkpoint`, and on any problem quarantine the snapshot
+    (committed ones only — torn snapshots are already invisible).  Returns
+    the problem list (empty == intact, snapshot untouched)."""
+    step_dir = Path(step_dir)
+    problems = verify_checkpoint(step_dir)
+    if problems and is_committed(step_dir):
+        quarantined = quarantine_checkpoint(step_dir)
+        if quarantined is not None:
+            problems = [*problems, f"quarantined to {quarantined}"]
     return problems
 
 

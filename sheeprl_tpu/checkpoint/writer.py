@@ -17,11 +17,26 @@ The queue is bounded (default 2 in-flight snapshots): if training
 checkpoints faster than the disk drains, ``submit`` blocks — back-pressure
 instead of unbounded host-memory growth from queued device copies.
 
-A failed job parks its exception and re-raises on the NEXT ``submit`` /
-``flush`` so a dying disk cannot silently drop checkpoints for the rest of
-a run.  Save timing/bytes are reported into
-``utils.profiler.CHECKPOINT_MONITOR`` and surface as ``Checkpoint/*``
-metrics through ``utils.metric.flush_metrics``.
+Liveness (the resilience layer, docs/resilience.md):
+
+* Transient IO errors (``OSError``) are retried with jittered exponential
+  backoff (``checkpoint.io_retries`` attempts) BEFORE the job's exception
+  is parked — an NFS blip no longer voids a snapshot.
+* A failed job parks its exception and re-raises on the NEXT ``submit`` /
+  ``flush`` so a dying disk cannot silently drop checkpoints for the rest
+  of a run.
+* A :class:`~sheeprl_tpu.resilience.retry.Watchdog` flags a job that has
+  made no progress for ``hang_warn_s`` (``Resilience/watchdog_stalls`` + a
+  warning) — the first visible symptom of a dead disk, minutes before any
+  syscall would error.
+* ``close()`` must return even when the worker is wedged mid-syscall on
+  dead storage: the drain wait and the thread join are both bounded, and
+  an un-joinable worker is ABANDONED with a logged warning (it is a daemon
+  thread; interpreter shutdown does not wait for it).
+
+Save timing/bytes are reported into ``utils.profiler.CHECKPOINT_MONITOR``
+and surface as ``Checkpoint/*`` metrics through
+``utils.metric.flush_metrics``.
 """
 
 from __future__ import annotations
@@ -29,15 +44,39 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import warnings
 from typing import Any, Callable, Optional
 
 from sheeprl_tpu.utils.profiler import CHECKPOINT_MONITOR
 
 
+def run_with_io_retry(job: Callable[[], Any], attempts: int, base_s: float) -> Any:
+    """THE transient-IO retry policy for checkpoint writes — shared by the
+    async writer and the manager's synchronous (preemption-final) path so
+    the two can never diverge."""
+    from sheeprl_tpu.resilience.retry import retry
+
+    return retry(
+        job,
+        attempts=attempts,
+        base_s=base_s,
+        max_s=30.0,
+        retry_on=(OSError,),
+        site="checkpoint.write",
+    )
+
+
 class AsyncCheckpointWriter:
     """Single background thread executing checkpoint save jobs in order."""
 
-    def __init__(self, queue_size: int = 2, name: str = "ckpt-writer"):
+    def __init__(
+        self,
+        queue_size: int = 2,
+        name: str = "ckpt-writer",
+        io_retries: int = 3,
+        io_retry_base_s: float = 0.5,
+        hang_warn_s: float = 120.0,
+    ):
         self._queue: "queue.Queue[Optional[Callable[[], Any]]]" = queue.Queue(
             maxsize=max(1, int(queue_size))
         )
@@ -51,10 +90,30 @@ class AsyncCheckpointWriter:
         self._pending = 0
         self._pending_lock = threading.Lock()
         self._closed = False
+        self._io_retries = max(1, int(io_retries))
+        self._io_retry_base_s = float(io_retry_base_s)
+        self._watchdog: Optional[Any] = None
+        if hang_warn_s and hang_warn_s > 0:
+            from sheeprl_tpu.resilience.retry import Watchdog
+
+            self._watchdog = Watchdog(
+                float(hang_warn_s),
+                on_stall=lambda stalled: warnings.warn(
+                    f"checkpoint writer job has made no progress for "
+                    f"{stalled:.0f}s — storage may be wedged",
+                    RuntimeWarning,
+                ),
+                name="ckpt-writer-watchdog",
+            )
         self._thread = threading.Thread(target=self._loop, name=name, daemon=True)
         self._thread.start()
 
     # -- worker --------------------------------------------------------------
+    def _run_job(self, job: Callable[[], Any]) -> Any:
+        """One job, with jittered-backoff retry on transient IO errors —
+        a blip must not park an exception and void the snapshot."""
+        return run_with_io_retry(job, self._io_retries, self._io_retry_base_s)
+
     def _loop(self) -> None:
         while True:
             job = self._queue.get()
@@ -62,8 +121,10 @@ class AsyncCheckpointWriter:
                 self._queue.task_done()
                 return
             t0 = time.perf_counter()
+            if self._watchdog is not None:
+                self._watchdog.arm()
             try:
-                nbytes = job()
+                nbytes = self._run_job(job)
                 CHECKPOINT_MONITOR.record_save(
                     seconds=time.perf_counter() - t0,
                     nbytes=int(nbytes or 0),
@@ -73,6 +134,8 @@ class AsyncCheckpointWriter:
                 self._error = e
                 CHECKPOINT_MONITOR.record_error()
             finally:
+                if self._watchdog is not None:
+                    self._watchdog.disarm()
                 self._queue.task_done()
                 with self._pending_lock:
                     self._pending -= 1
@@ -111,16 +174,36 @@ class AsyncCheckpointWriter:
     def close(self, timeout_s: Optional[float] = 300.0) -> None:
         """Drain outstanding jobs and stop the thread (idempotent).  Must
         return within ~``timeout_s`` even when the worker is wedged on a
-        dead disk: the sentinel put uses a timeout too — a full bounded
-        queue under a stuck worker would otherwise block forever, and the
-        daemon thread can simply be abandoned at process exit."""
+        dead disk: every wait below is bounded, the sentinel put uses a
+        timeout too (a full bounded queue under a stuck worker would
+        otherwise block forever), and an un-joinable worker is abandoned
+        with a warning — it is a daemon thread, so interpreter shutdown
+        does not hang on it."""
         if self._closed:
             return
         self._closed = True
         drained = self._idle.wait(timeout_s)
+        # the wedged path's residual waits scale DOWN with a small timeout_s
+        # (close(0.3) must not spend a fixed 5+5s on sentinel + join)
+        grace = 5.0 if timeout_s is None else max(0.1, min(5.0, float(timeout_s)))
         try:
-            self._queue.put(None, timeout=5.0)
+            self._queue.put(None, timeout=grace)
         except queue.Full:
-            pass  # wedged worker + full queue: abandon the daemon thread
-        self._thread.join(timeout=timeout_s if drained else 5.0)
+            pass  # wedged worker + full queue: the join below gives up fast
+        self._thread.join(timeout_s if drained else grace)
+        if self._thread.is_alive():
+            abandoned = max(self.in_flight, 1)
+            try:
+                warnings.warn(
+                    f"checkpoint writer did not drain within "
+                    f"{timeout_s if drained else grace}s; abandoning the daemon "
+                    f"thread with ~{abandoned} job(s) wedged (likely dead "
+                    "storage) — those snapshots stay uncommitted and are "
+                    "invisible to resume",
+                    RuntimeWarning,
+                )
+            except Exception:
+                pass  # warning machinery can be torn down at interpreter exit
+        if self._watchdog is not None:
+            self._watchdog.close()
         self._raise_pending()

@@ -161,8 +161,31 @@ def resolve_resume_target(cfg: dotdict) -> dotdict:
     if cfg.checkpoint.get("resume_from") != "auto":
         return cfg
     from sheeprl_tpu.checkpoint import resolve_auto_resume
+    from sheeprl_tpu.checkpoint.protocol import verify_or_quarantine
 
+    # a committed snapshot can still be damaged (bit rot, a torn write that
+    # raced the manifest): verify the CRCs before trusting it, quarantine
+    # (step_* → step_*.corrupt) on mismatch, and fall back to the next
+    # newest committed snapshot instead of crashing the resume
+    verify = bool(cfg.checkpoint.get("verify_on_resume", True))
+    # quarantine can fail (read-only mount): a damaged snapshot that cannot
+    # be renamed is EXCLUDED from re-resolution instead of re-tried forever,
+    # so older intact commits are still found
+    damaged: set = set()
     target = resolve_auto_resume(cfg.get("log_dir", "logs/runs"), cfg.root_dir)
+    while target is not None and verify:
+        problems = verify_or_quarantine(target)
+        if not problems:
+            break
+        warnings.warn(
+            f"checkpoint.resume_from=auto: {target} is damaged "
+            f"({'; '.join(problems)}); trying the next committed snapshot",
+            RuntimeWarning,
+        )
+        damaged.add(target)
+        target = resolve_auto_resume(
+            cfg.get("log_dir", "logs/runs"), cfg.root_dir, exclude=damaged
+        )
     if target is None:
         warnings.warn(
             f"checkpoint.resume_from=auto: no committed checkpoint found under "
@@ -185,6 +208,11 @@ def run(argv: Optional[List[str]] = None) -> None:
 
     PREEMPTION_GUARD.clear_latch()
     cfg = compose(argv)
+    # arm (or explicitly clear) the fault-injection plan before anything
+    # else touches envs/checkpoints — SHEEPRL_FAULT_PLAN wins over the group
+    from sheeprl_tpu.resilience import install_from_config
+
+    install_from_config(cfg)
     cfg = resolve_resume_target(cfg)
     if cfg.checkpoint.get("resume_from"):
         cfg = resume_from_checkpoint(cfg)
@@ -281,7 +309,15 @@ def serve(argv: Optional[List[str]] = None) -> None:
     from sheeprl_tpu.serve import PolicyService
     from sheeprl_tpu.serve.server import PolicyServer
 
+    # SHEEPRL_FAULT_PLAN plans arm BEFORE the checkpoint resolve/load so
+    # startup-path sites (fabric.copy_to, the loader) are covered; a
+    # config-group plan can only arm after the run config is loaded from
+    # next to the checkpoint, i.e. it covers the serving phase only
+    from sheeprl_tpu.resilience import install_from_config, install_from_env
+
+    install_from_env()
     service = PolicyService.from_checkpoint(ckpt_override[0].split("=", 1)[1], rest)
+    install_from_config(service.cfg)
     serve_cfg = service.cfg.get("serve") or {}
     server = PolicyServer(
         service,

@@ -284,6 +284,30 @@ class ActionsAsObservationWrapper(gym.Wrapper):
         return self._obs_with_actions(obs), info
 
 
+class FaultInjectionEnv(gym.Wrapper):
+    """Fire the resilience engine's ``env.step`` / ``env.reset`` injection
+    sites (``sheeprl_tpu.resilience.faults``) around the wrapped env.
+
+    Only applied by ``utils.env.make_env`` when an active fault plan targets
+    an ``env.*`` site, so the disabled path adds no wrapper at all.  It sits
+    INSIDE :class:`RestartOnException` (injected crashes exercise the real
+    restart path) and inside the vector worker (injected hangs exercise the
+    vector-level step-deadline watchdog).
+    """
+
+    def step(self, action: Any) -> Tuple[Any, SupportsFloat, bool, bool, Dict[str, Any]]:
+        from sheeprl_tpu.resilience.faults import fault_point
+
+        fault_point("env.step")
+        return self.env.step(action)
+
+    def reset(self, **kwargs: Any) -> Tuple[Any, Dict[str, Any]]:
+        from sheeprl_tpu.resilience.faults import fault_point
+
+        fault_point("env.reset")
+        return self.env.reset(**kwargs)
+
+
 class GrayscaleRenderWrapper(gym.Wrapper):
     """Make ``render()`` return 3-channel frames for video capture even when
     observations are grayscale (reference: envs/wrappers.py:244-255)."""

@@ -253,6 +253,12 @@ class Fabric:
         so same-dtype leaves are flattened into one device-side buffer per
         dtype, moved in one transfer, and split on the target.
         """
+        # chaos-drill injection site: raise simulates a dropped tunnel link
+        # mid-param-pull, latency a congested one (no-op unless a fault plan
+        # targets fabric.copy_to)
+        from sheeprl_tpu.resilience.faults import fault_point
+
+        fault_point("fabric.copy_to")
         leaves, treedef = jax.tree.flatten(tree)
         if all(isinstance(x, jax.Array) and x.is_fully_addressable for x in leaves):
             # replicated multi-device params (any real mesh) carry the full

@@ -1,0 +1,43 @@
+"""Chaos-hardened runtime: fault injection + recovery primitives.
+
+``faults`` is the seeded, deterministic injection engine (driven by the
+``fault_injection`` config group / ``SHEEPRL_FAULT_PLAN``, compiled to a
+no-op when empty); ``retry`` holds the liveness half — jittered-backoff
+:func:`retry`, the heartbeat :class:`Watchdog`, and the
+:class:`CircuitBreaker` — all reporting ``Resilience/*`` metrics through
+``utils.profiler.RESILIENCE_MONITOR``.  See docs/resilience.md.
+"""
+
+from sheeprl_tpu.resilience.faults import (
+    ENV_VAR,
+    KNOWN_SITES,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    fault_bytes,
+    fault_point,
+    install_from_config,
+    install_from_env,
+    install_plan,
+)
+from sheeprl_tpu.resilience.retry import CircuitBreaker, Watchdog, retry
+
+__all__ = [
+    "ENV_VAR",
+    "KNOWN_SITES",
+    "CircuitBreaker",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "Watchdog",
+    "active_plan",
+    "clear_plan",
+    "fault_bytes",
+    "fault_point",
+    "install_from_config",
+    "install_from_env",
+    "install_plan",
+    "retry",
+]

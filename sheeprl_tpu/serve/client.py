@@ -6,6 +6,22 @@ including the packed base64 array encoding for pixel observations.  Use
 ``session=`` for stateful policies (dreamer_v3): the server keeps one
 latent carry per session id, reset at episode boundaries via
 :meth:`PolicyClient.reset`.
+
+Error surfacing + liveness:
+
+* every non-2xx answer raises a typed :class:`ServeRequestError` carrying
+  the HTTP status and a (truncated) copy of the raw body — non-JSON error
+  pages (a proxy's HTML 502, a half-written response) are no longer
+  swallowed into a bare re-raise;
+* connection-level errors (refused, reset, timeout) and 5xx answers to
+  **idempotent** requests are retried with jittered exponential backoff
+  (``retries``/``retry_base_s``), so a server mid-restart or an injected
+  ``serve.http`` fault costs latency, not a dropped request.  ``act`` is
+  idempotent exactly when it carries no ``session`` (a stateful act
+  advances the server-side latent carry, so a response lost on the wire
+  must not be silently replayed); 429 (load shed) and other 4xx are never
+  retried — they are the server telling the client to back off or fix the
+  request.
 """
 
 from __future__ import annotations
@@ -19,25 +35,50 @@ import numpy as np
 
 from sheeprl_tpu.serve.server import decode_array, encode_array
 
+#: bytes of a non-JSON error body kept on the exception
+_BODY_TRUNCATE = 512
 
-class ServerError(RuntimeError):
-    """Non-2xx response from the policy server."""
 
-    def __init__(self, status: int, message: str):
-        super().__init__(f"HTTP {status}: {message}")
-        self.status = status
+class ServeRequestError(RuntimeError):
+    """Non-2xx response from the policy server.
+
+    ``status`` is the HTTP code; ``body`` is the error body — the server's
+    JSON ``error`` field when parseable, otherwise the raw payload decoded
+    and truncated to ~512 chars (so a proxy's HTML error page stays
+    diagnosable instead of vanishing into a bare re-raise).
+    """
+
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body}")
+        self.status = int(status)
+        self.body = body
+
+
+#: Backwards-compatible alias (the pre-resilience exception name).
+ServerError = ServeRequestError
 
 
 class PolicyClient:
-    def __init__(self, base_url: str, timeout: float = 30.0, packed: bool = False):
+    def __init__(
+        self,
+        base_url: str,
+        timeout: float = 30.0,
+        packed: bool = False,
+        retries: int = 3,
+        retry_base_s: float = 0.2,
+    ):
         """``packed=True`` ships/returns arrays as base64 blobs instead of
-        nested JSON lists — much cheaper for image observations."""
+        nested JSON lists — much cheaper for image observations.
+        ``retries`` bounds the transparent retry of connection errors and
+        of 5xx answers to idempotent requests (1 = never retry)."""
         self.base_url = base_url.rstrip("/")
         self.timeout = float(timeout)
         self.packed = bool(packed)
+        self.retries = max(1, int(retries))
+        self.retry_base_s = float(retry_base_s)
 
     # -- transport ----------------------------------------------------------
-    def _call(self, method: str, path: str, body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    def _call_once(self, method: str, path: str, body: Optional[Dict[str, Any]]) -> Dict[str, Any]:
         data = None if body is None else json.dumps(body).encode()
         req = urllib.request.Request(
             self.base_url + path,
@@ -49,11 +90,48 @@ class PolicyClient:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read() or b"{}")
         except urllib.error.HTTPError as e:
+            raw = b""
             try:
-                message = json.loads(e.read() or b"{}").get("error", str(e))
+                raw = e.read() or b""
             except Exception:
-                message = str(e)
-            raise ServerError(e.code, message) from None
+                pass
+            try:
+                message = json.loads(raw)["error"]
+            except Exception:
+                # non-JSON body: surface it (truncated), not a bare re-raise
+                message = raw.decode("utf-8", "replace")[:_BODY_TRUNCATE] or str(e)
+            raise ServeRequestError(e.code, message) from None
+
+    def _call(
+        self,
+        method: str,
+        path: str,
+        body: Optional[Dict[str, Any]] = None,
+        idempotent: bool = True,
+    ) -> Dict[str, Any]:
+        from sheeprl_tpu.resilience.retry import retry
+
+        def transient(e: BaseException) -> bool:
+            if isinstance(e, ServeRequestError):
+                # 5xx only, and only when replaying the request is safe
+                return idempotent and e.status >= 500
+            # URLError (refused/reset/DNS), timeouts, dropped connections:
+            # for non-idempotent requests only connection-REFUSED-class
+            # errors are safely retriable (the request never reached the
+            # server); a mid-flight drop might have been processed
+            if isinstance(e, urllib.error.URLError):
+                return idempotent or isinstance(e.reason, ConnectionRefusedError)
+            return idempotent and isinstance(e, (ConnectionError, TimeoutError, OSError))
+
+        return retry(
+            lambda: self._call_once(method, path, body),
+            attempts=self.retries,
+            base_s=self.retry_base_s,
+            max_s=5.0,
+            retry_on=(ServeRequestError, urllib.error.URLError, ConnectionError, TimeoutError, OSError),
+            should_retry=transient,
+            site="serve.client",
+        )
 
     # -- API ----------------------------------------------------------------
     def act(
@@ -73,13 +151,16 @@ class PolicyClient:
             body["session"] = session
         if timeout is not None:
             body["timeout"] = float(timeout)
-        out = self._call("POST", "/v1/act", body)
+        # a stateful act advances the server-side carry: replaying it after
+        # a lost response would double-step the episode — not idempotent
+        out = self._call("POST", "/v1/act", body, idempotent=session is None)
         action = decode_array(out["action"], dtype=out.get("dtype"))
         self.last_generation = out.get("generation")
         self.last_checkpoint_step = out.get("checkpoint_step")
         return np.asarray(action).reshape(out.get("shape", np.asarray(action).shape))
 
     def reset(self, session: str) -> None:
+        # dropping a carry twice is harmless — idempotent
         self._call("POST", "/v1/reset", {"session": session})
 
     def reload(self) -> Dict[str, Any]:

@@ -24,11 +24,23 @@ from sheeprl_tpu.config.compose import ConfigError
 from sheeprl_tpu.utils.structured import dotdict
 
 
-def resolve_checkpoint(path: Any) -> pathlib.Path:
+def resolve_checkpoint(path: Any, verify: bool = True) -> pathlib.Path:
     """Resolve any checkpoint spelling to a loadable target: a committed
-    ``step_*`` directory or a legacy ``.ckpt`` file."""
-    from sheeprl_tpu.checkpoint import is_committed, latest_checkpoint
-    from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+    ``step_*`` directory or a legacy ``.ckpt`` file.
+
+    With ``verify`` (the default), a resolved snapshot's shards are CRC-
+    checked against its manifest BEFORE it is trusted: a damaged snapshot
+    found under a root is quarantined (``step_*`` → ``step_*.corrupt``) and
+    the next newest committed one is used instead — serving/evaluation skip
+    bit rot instead of crashing on it.  An EXPLICITLY named ``step_*``
+    directory that fails verification raises (it is never renamed behind
+    the caller's back)."""
+    from sheeprl_tpu.checkpoint import is_committed
+    from sheeprl_tpu.checkpoint.protocol import (
+        checkpoint_step,
+        verify_checkpoint,
+        verify_or_quarantine,
+    )
 
     p = pathlib.Path(path)
     if p.is_file():  # legacy flat file
@@ -41,6 +53,13 @@ def resolve_checkpoint(path: Any) -> pathlib.Path:
                 f"{p} is an uncommitted (torn) snapshot — it has no COMMIT "
                 "marker and cannot be served or evaluated"
             )
+        if verify:
+            problems = verify_checkpoint(p)
+            if problems:
+                raise ConfigError(
+                    f"{p} is a damaged snapshot ({'; '.join(problems)}) and "
+                    "cannot be served or evaluated"
+                )
         return p
     # a checkpoint root, version dir, or run dir: find the newest committed
     # snapshot underneath (searching <p>/checkpoint first, then <p> itself,
@@ -51,10 +70,28 @@ def resolve_checkpoint(path: Any) -> pathlib.Path:
         key=lambda d: int(d.parent.name.rsplit("_", 1)[-1]),
         reverse=True,
     )
+    from sheeprl_tpu.checkpoint import list_checkpoints
+
+    damaged: set = set()
     for root in candidates:
-        newest = latest_checkpoint(root) if root.is_dir() else None
-        if newest is not None:
-            return newest
+        if not root.is_dir():
+            continue
+        # newest first; skip known-damaged entries rather than breaking out,
+        # so a quarantine rename failing (read-only store) still falls back
+        # to the older intact commits under the same root
+        for candidate in reversed(list_checkpoints(root)):
+            if candidate in damaged:
+                continue
+            if not verify or not verify_or_quarantine(candidate):
+                return candidate
+            damaged.add(candidate)
+            import warnings
+
+            warnings.warn(
+                f"skipping damaged snapshot {candidate} (quarantined); trying "
+                "the next committed one",
+                RuntimeWarning,
+            )
     # legacy flat layout fallback
     for root in candidates:
         if root.is_dir():
@@ -205,7 +242,27 @@ def load_policy(
     sheeprl_tpu.register_all_algorithms()
     if fabric is None:
         fabric = build_fabric(cfg)
-    state = fabric.load(ckpt)
+    # a retention pass (gc_checkpoints) on the training side can delete the
+    # snapshot between discovery and read: re-resolve a NEWER committed one
+    # and retry instead of crashing — by the commit protocol, a newer commit
+    # always exists before GC removes an older snapshot
+    try:
+        state = fabric.load(ckpt)
+    except FileNotFoundError:
+        from sheeprl_tpu.resilience.retry import retry
+
+        def reresolve_and_load():
+            nonlocal ckpt
+            ckpt = resolve_checkpoint(checkpoint_path)
+            return fabric.load(ckpt)
+
+        state = retry(
+            reresolve_and_load,
+            attempts=3,
+            base_s=0.2,
+            retry_on=(FileNotFoundError,),
+            site="serve.load",
+        )
     player = build_player(fabric, cfg, state)
     player.checkpoint_step = checkpoint_step(ckpt)
     return fabric, cfg, state, player

@@ -12,15 +12,27 @@ reference once at batch start, so a swap mid-batch only affects the NEXT
 batch.  Shapes/dtypes/placement of the new tree are identical to the old
 one (same agent, same fabric), so the warmed executables accept it without
 recompiling.
+
+Failure containment (the resilience layer, docs/resilience.md): a load
+failure NEVER interrupts serving — the store keeps the old params.  A
+:class:`~sheeprl_tpu.resilience.retry.CircuitBreaker` counts consecutive
+failures; after ``failure_threshold`` failed loads of the SAME snapshot
+that snapshot is declared poisoned and QUARANTINED
+(``checkpoint.protocol.quarantine_checkpoint`` → ``step_*.corrupt``), so
+discovery moves on to the next commit instead of hammering a corrupt
+directory forever.  While the breaker is open the watcher skips load
+attempts for its cool-down; breaker state is surfaced in ``/healthz``
+(``degraded: true``) and ``/v1/stats``.
 """
 
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
+
+from sheeprl_tpu.resilience.retry import CircuitBreaker
 
 
 class ParamStore:
@@ -73,11 +85,16 @@ class CommitWatcher:
         load_params: Callable[[Any], Any],
         poll_s: float = 2.0,
         on_reload: Optional[Callable[[int, int], None]] = None,
+        failure_threshold: int = 3,
+        breaker_reset_s: float = 30.0,
+        quarantine: bool = True,
     ):
         """``load_params(step_dir) -> device tree`` does the rank-shard read
         + host→device transfer (built by the service from the player's
         extract rule); ``on_reload(generation, step)`` is a notification
-        hook (stats, logs)."""
+        hook (stats, logs).  ``failure_threshold`` consecutive failed loads
+        of the same snapshot quarantine it (when ``quarantine``) and open
+        the breaker for ``breaker_reset_s``."""
         self._ckpt_root = ckpt_root
         self._store = store
         self._load_params = load_params
@@ -86,7 +103,18 @@ class CommitWatcher:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._poll_lock = threading.Lock()
+        self._quarantine = bool(quarantine)
+        self.breaker = CircuitBreaker(
+            failure_threshold=failure_threshold,
+            reset_timeout_s=breaker_reset_s,
+            name="serve.reload",
+        )
+        # consecutive-failure tracking is per SNAPSHOT: a new commit landing
+        # mid-streak must get a fresh budget, not inherit the poisoned one's
+        self._failing_step: Optional[int] = None
+        self._failing_count = 0
         self.reloads = 0
+        self.quarantined = 0
         self.last_error: Optional[str] = None
 
     def start(self) -> None:
@@ -99,6 +127,21 @@ class CommitWatcher:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout)
+
+    @property
+    def degraded(self) -> bool:
+        """Serving old params because new commits cannot be loaded."""
+        return self.breaker.state != CircuitBreaker.CLOSED
+
+    def health(self) -> Dict[str, Any]:
+        """Breaker/quarantine state for ``/healthz`` and ``/v1/stats``."""
+        return {
+            "breaker": self.breaker.snapshot(),
+            "degraded": self.degraded,
+            "reloads": self.reloads,
+            "quarantined": self.quarantined,
+            "last_error": self.last_error,
+        }
 
     def poll_once(self) -> Optional[int]:
         """One synchronous check (also used by the HTTP ``/v1/reload``
@@ -114,7 +157,21 @@ class CommitWatcher:
             found = newer_checkpoint(self._ckpt_root, self._store.step)
             if found is None:
                 return None
+            if not self.breaker.allow():
+                # open breaker: keep serving old params, don't hammer a
+                # snapshot that just failed repeatedly — retry after the
+                # cool-down (half-open probe)
+                return None
+            found_step = checkpoint_step(found)
             try:
+                # CRC-verify BEFORE unpickling: a bit flip in raw array data
+                # unpickles "successfully" into poisoned params — the
+                # manifest check is the only way to catch it
+                from sheeprl_tpu.checkpoint.protocol import verify_checkpoint
+
+                problems = verify_checkpoint(found)
+                if problems:
+                    raise IOError(f"snapshot failed verification: {'; '.join(problems)}")
                 new_params = self._load_params(found)
                 # the transfer above allocated fresh device buffers; fence it
                 # so the swap publishes a fully-materialized tree
@@ -123,13 +180,36 @@ class CommitWatcher:
                         leaf.block_until_ready()
             except Exception as e:  # a torn read mid-GC, OOM, … — keep serving
                 self.last_error = f"{type(e).__name__}: {e}"
+                self._record_failure(found, found_step)
                 return None
-            gen = self._store.swap(new_params, checkpoint_step(found))
+            gen = self._store.swap(new_params, found_step)
             self.reloads += 1
             self.last_error = None
+            self._failing_step, self._failing_count = None, 0
+            self.breaker.record_success()
             if self._on_reload is not None:
                 self._on_reload(gen, self._store.step)
             return gen
+
+    def _record_failure(self, found: Any, found_step: int) -> None:
+        """Count consecutive failures of one snapshot; at the threshold,
+        quarantine it so discovery moves past the poison."""
+        if self._failing_step == found_step:
+            self._failing_count += 1
+        else:
+            self._failing_step, self._failing_count = found_step, 1
+        self.breaker.record_failure()
+        if self._quarantine and self._failing_count >= self.breaker.failure_threshold:
+            from sheeprl_tpu.checkpoint.protocol import quarantine_checkpoint
+
+            target = quarantine_checkpoint(found)
+            if target is not None:
+                self.quarantined += 1
+                self.last_error = (
+                    f"{self.last_error} — quarantined {found} after "
+                    f"{self._failing_count} failed loads"
+                )
+            self._failing_step, self._failing_count = None, 0
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -142,11 +142,24 @@ def _make_handler(service: Any):
         # -- routes --------------------------------------------------------
         def do_GET(self) -> None:  # noqa: N802 (stdlib naming)
             try:
+                # chaos-drill injection site: raise → 500 (client retries
+                # idempotent requests), hang/latency → a slow or stuck reply
+                from sheeprl_tpu.resilience.faults import fault_point
+
+                fault_point("serve.http")
                 if self.path == "/healthz":
+                    watcher = service.watcher
                     self._reply(
                         200,
                         {
                             "ok": True,
+                            # degraded: the reload breaker is open/half-open —
+                            # new commits fail to load and the server keeps
+                            # serving the OLD params (liveness over freshness)
+                            "degraded": watcher.degraded if watcher else False,
+                            "reload_breaker": (
+                                watcher.breaker.snapshot() if watcher else None
+                            ),
                             "algo": service.player.algo,
                             "checkpoint_step": service.store.step,
                             "generation": service.store.generation,
@@ -170,6 +183,9 @@ def _make_handler(service: Any):
 
         def do_POST(self) -> None:  # noqa: N802
             try:
+                from sheeprl_tpu.resilience.faults import fault_point
+
+                fault_point("serve.http")
                 if self.path == "/v1/act":
                     self._act()
                 elif self.path == "/v1/reset":

@@ -69,6 +69,9 @@ class PolicyService:
                 self.store,
                 self._load_player_params,
                 poll_s=self._poll_s,
+                failure_threshold=int(serve_cfg.get("reload_failure_threshold", 3)),
+                breaker_reset_s=float(serve_cfg.get("reload_breaker_reset_s", 30.0)),
+                quarantine=bool(serve_cfg.get("quarantine_poisoned", True)),
             )
         self._sessions: Dict[str, tuple] = {}
         self._sessions_lock = threading.Lock()
@@ -293,6 +296,11 @@ class PolicyService:
             "checkpoint_step": self.store.step,
             "reloads": self.watcher.reloads if self.watcher else 0,
             "reload_error": self.watcher.last_error if self.watcher else None,
+            # reload circuit breaker: open/half_open means new commits are
+            # failing to load and the server keeps serving the old params
+            "degraded": self.watcher.degraded if self.watcher else False,
+            "reload_breaker": self.watcher.breaker.snapshot() if self.watcher else None,
+            "quarantined": self.watcher.quarantined if self.watcher else 0,
             "batch_ladder": list(self.ladder),
             "compile_executables": n_exe,
             "compile_time_s": round(compile_s, 3),

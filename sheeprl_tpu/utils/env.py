@@ -14,6 +14,7 @@ convolutions are natively NHWC); the reference uses torch's ``(C, H, W)``.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, Optional
 
 import gymnasium as gym
@@ -235,6 +236,21 @@ def make_env(
         if seed is not None:
             env.reset(seed=seed + rank * cfg.env.num_envs + vector_env_idx)
             env.action_space.seed(seed + rank * cfg.env.num_envs + vector_env_idx)
+
+        # chaos drills: fire the env.step/env.reset injection sites — only
+        # wrapped when an active fault plan targets them, so the disabled
+        # path adds no wrapper (and no per-step overhead) at all.  Applied
+        # after seeding (construction resets are not injection targets) and
+        # INSIDE RestartOnException, so injected crashes exercise the real
+        # restart path and injected hangs wedge the vector worker the
+        # step-deadline watchdog guards against.
+        from sheeprl_tpu.resilience.faults import active_plan
+
+        plan = active_plan()
+        if plan is not None and plan.targets("env."):
+            from sheeprl_tpu.envs.wrappers import FaultInjectionEnv
+
+            env = FaultInjectionEnv(env)
         return env
 
     return thunk
@@ -274,12 +290,158 @@ def final_obs_rows(info: Dict[str, Any], env_indices: np.ndarray, obs_keys) -> O
     return {k: np.stack([np.asarray(r[k]) for r in rows]) for k in obs_keys}
 
 
+class StepDeadlineVectorEnv:
+    """Liveness watchdog around ``AsyncVectorEnv``: a wedged env worker
+    (deadlocked engine, NFS stall, injected hang) no longer deadlocks the
+    run forever.
+
+    ``RestartOnException`` (inside each worker) only catches *exceptions*; a
+    worker that simply stops answering leaves ``AsyncVectorEnv.step``
+    blocked with no timeout.  This wrapper drives the async pair itself —
+    ``step_async`` + ``step_wait(timeout=deadline_s)`` (and the same for
+    ``reset``) — and on a deadline miss tears the whole vector env down
+    (``close(terminate=True)`` SIGTERMs the stuck workers), recreates it
+    from the original thunks, resets, and reports the break to the train
+    loop through the same ``info["restart_on_exception"]`` contract the
+    per-env restart wrapper uses, so sequence replay patches its tail
+    (``ReplayBuffer.repair_tail``) instead of bootstrapping across the gap.
+
+    At most ``max_restarts`` teardowns within ``window_s`` seconds; beyond
+    that the timeout propagates as ``RuntimeError`` — a persistently wedged
+    fleet should fail the run, not loop silently.
+    """
+
+    def __init__(
+        self,
+        make_vec: Callable[[], gym.vector.VectorEnv],
+        deadline_s: float,
+        max_restarts: int = 3,
+        window_s: float = 600.0,
+    ):
+        from collections import deque
+
+        self._make_vec = make_vec
+        self._deadline = float(deadline_s)
+        self._max_restarts = int(max_restarts)
+        self._window = float(window_s)
+        self._restart_times: Any = deque()
+        self._env = make_vec()
+
+    def __getattr__(self, name: str) -> Any:
+        # spaces, num_envs, call(), metadata… all delegate to the live env.
+        # Private names never delegate: looking up self._env before __init__
+        # finished (failed construction) must raise, not recurse.
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._env, name)
+
+    @property
+    def unwrapped(self) -> gym.vector.VectorEnv:
+        return self._env
+
+    def _spend_restart_budget(self, reason: str) -> None:
+        now = time.monotonic()
+        while self._restart_times and now - self._restart_times[0] > self._window:
+            self._restart_times.popleft()
+        if len(self._restart_times) >= self._max_restarts:
+            raise RuntimeError(
+                f"vector env wedged {len(self._restart_times) + 1} times within "
+                f"{self._window}s ({reason}); giving up"
+            )
+        self._restart_times.append(now)
+
+    def _teardown_and_recreate(self, reason: str) -> Dict[str, Any]:
+        import multiprocessing as mp
+        import warnings
+
+        from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+
+        # the recovery reset gets the SAME deadline as a step — a worker
+        # that wedges during reset too must spend restart budget per
+        # attempt and eventually propagate, not hang the watchdog itself
+        while True:
+            self._spend_restart_budget(reason)
+            warnings.warn(
+                f"vector env watchdog: {reason}; terminating workers and recreating",
+                RuntimeWarning,
+            )
+            RESILIENCE_MONITOR.record_stall("vecenv.step")
+            RESILIENCE_MONITOR.record_env_restart(getattr(self._env, "num_envs", 1))
+            try:
+                self._env.close(timeout=5.0, terminate=True)
+            except (mp.TimeoutError, OSError, RuntimeError, EOFError):
+                pass
+            self._env = self._make_vec()
+            try:
+                self._env.reset_async()
+                obs, info = self._env.reset_wait(timeout=self._deadline)
+                break
+            except mp.TimeoutError:
+                reason = f"recovery reset exceeded the {self._deadline}s deadline"
+        info = dict(info)
+        # every env restarted: the whole batch of streams broke
+        info["restart_on_exception"] = np.ones(self._env.num_envs, dtype=bool)
+        return {"obs": obs, "info": info}
+
+    def step(self, actions: Any):
+        import multiprocessing as mp
+
+        try:
+            self._env.step_async(actions)
+            return self._env.step_wait(timeout=self._deadline)
+        except mp.TimeoutError:
+            out = self._teardown_and_recreate(
+                f"step exceeded the {self._deadline}s deadline"
+            )
+            n = self._env.num_envs
+            return (
+                out["obs"],
+                np.zeros(n, dtype=np.float64),
+                np.zeros(n, dtype=bool),
+                np.zeros(n, dtype=bool),
+                out["info"],
+            )
+
+    def reset(self, **kwargs: Any):
+        import multiprocessing as mp
+
+        try:
+            self._env.reset_async(**kwargs)
+            return self._env.reset_wait(timeout=self._deadline)
+        except mp.TimeoutError:
+            out = self._teardown_and_recreate(
+                f"reset exceeded the {self._deadline}s deadline"
+            )
+            return out["obs"], out["info"]
+
+    def close(self, **kwargs: Any) -> None:
+        self._env.close(**kwargs)
+
+
 def vectorize(cfg: Any, thunks: list) -> gym.vector.VectorEnv:
     """Vectorize with SAME_STEP autoreset so rollout loops observe the
     pre-1.0 gymnasium semantics the algorithms are written against
-    (final observations surfaced via ``info["final_obs"]``)."""
+    (final observations surfaced via ``info["final_obs"]``).
+
+    The async path is wrapped in :class:`StepDeadlineVectorEnv` when
+    ``env.step_deadline_s`` > 0 (the default), so a wedged worker is
+    detected and restarted instead of deadlocking the run; the sync path
+    runs envs on the caller thread where a hang IS the caller hanging —
+    nothing to watchdog from inside the process."""
     from gymnasium.vector import AutoresetMode
 
     if cfg.env.sync_env:
         return gym.vector.SyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
-    return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+
+    def make() -> gym.vector.VectorEnv:
+        return gym.vector.AsyncVectorEnv(thunks, autoreset_mode=AutoresetMode.SAME_STEP)
+
+    deadline = float(cfg.env.get("step_deadline_s", 0) or 0)
+    if deadline > 0:
+        return StepDeadlineVectorEnv(
+            make,
+            deadline,
+            max_restarts=int(cfg.env.get("max_vecenv_restarts", 3) or 3),
+            window_s=float(cfg.env.get("vecenv_restart_window_s", 600.0) or 600.0),
+        )
+    return make()

@@ -167,13 +167,21 @@ def flush_metrics(
     # growing after warm-up IS the recompile pathology the detector exists
     # for — surfacing it in the normal metric stream makes it visible in
     # TensorBoard without a debugger attached.
-    from sheeprl_tpu.utils.profiler import CHECKPOINT_MONITOR, COMPILE_MONITOR
+    from sheeprl_tpu.utils.profiler import (
+        CHECKPOINT_MONITOR,
+        COMPILE_MONITOR,
+        RESILIENCE_MONITOR,
+    )
 
     metrics.update(COMPILE_MONITOR.compile_metrics())
     # checkpointing subsystem accounting (sheeprl_tpu/checkpoint): last save
     # wall time + bytes, recorded by the (possibly background) writer —
     # surfaces async-save cost in the normal metric stream
     metrics.update(CHECKPOINT_MONITOR.metrics())
+    # resilience accounting (sheeprl_tpu/resilience): retries, watchdog
+    # stalls, env restarts, breaker opens, injected faults — empty (no
+    # Resilience/* keys at all) unless something actually happened
+    metrics.update(RESILIENCE_MONITOR.metrics())
     if logger is not None and metrics:
         logger.log_metrics(metrics, policy_step)
     return policy_step

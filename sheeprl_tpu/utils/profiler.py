@@ -224,6 +224,107 @@ class CheckpointMonitor:
 CHECKPOINT_MONITOR = CheckpointMonitor()
 
 
+class ResilienceMonitor:
+    """Process-global accounting for the resilience subsystem
+    (``sheeprl_tpu.resilience``) — retries, watchdog stalls, env restarts,
+    circuit-breaker transitions, quarantined snapshots, injected faults.
+    Same pattern as the other monitors: primitives record from any thread,
+    ``metric.flush_metrics`` surfaces the counters as ``Resilience/*``.
+
+    When nothing has been recorded, :meth:`metrics` returns ``{}`` — a run
+    with fault injection disabled and no recoveries emits NO ``Resilience/*``
+    metrics at all (part of the zero-overhead-when-disabled gate)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._retries = 0
+            self._retry_successes = 0
+            self._giveups = 0
+            self._stalls = 0
+            self._env_restarts = 0
+            self._breaker_opens = 0
+            self._quarantined = 0
+            self._injected = 0
+            self._injected_by_site: Dict[str, int] = {}
+
+    def record_retry(self, site: str = "") -> None:
+        with self._lock:
+            self._retries += 1
+
+    def record_retry_success(self, site: str = "") -> None:
+        with self._lock:
+            self._retry_successes += 1
+
+    def record_giveup(self, site: str = "") -> None:
+        with self._lock:
+            self._giveups += 1
+
+    def record_stall(self, name: str = "") -> None:
+        with self._lock:
+            self._stalls += 1
+
+    def record_env_restart(self, count: int = 1) -> None:
+        with self._lock:
+            self._env_restarts += int(count)
+
+    def record_breaker(self, name: str, state: str) -> None:
+        if state == "open":
+            with self._lock:
+                self._breaker_opens += 1
+
+    def record_quarantine(self, path: Any = None) -> None:
+        with self._lock:
+            self._quarantined += 1
+
+    def record_injection(self, site: str, kind: str) -> None:
+        with self._lock:
+            self._injected += 1
+            self._injected_by_site[site] = self._injected_by_site.get(site, 0) + 1
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            out: Dict[str, float] = {}
+            if self._retries:
+                out["Resilience/retries"] = float(self._retries)
+            if self._retry_successes:
+                out["Resilience/retry_successes"] = float(self._retry_successes)
+            if self._giveups:
+                out["Resilience/giveups"] = float(self._giveups)
+            if self._stalls:
+                out["Resilience/watchdog_stalls"] = float(self._stalls)
+            if self._env_restarts:
+                out["Resilience/env_restarts"] = float(self._env_restarts)
+            if self._breaker_opens:
+                out["Resilience/breaker_opens"] = float(self._breaker_opens)
+            if self._quarantined:
+                out["Resilience/quarantined_snapshots"] = float(self._quarantined)
+            if self._injected:
+                out["Resilience/faults_injected"] = float(self._injected)
+            return out
+
+    def totals(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "retries": self._retries,
+                "retry_successes": self._retry_successes,
+                "giveups": self._giveups,
+                "stalls": self._stalls,
+                "env_restarts": self._env_restarts,
+                "breaker_opens": self._breaker_opens,
+                "quarantined": self._quarantined,
+                "injected": self._injected,
+                "injected_by_site": dict(self._injected_by_site),
+            }
+
+
+#: The process-global monitor every resilience primitive reports into.
+RESILIENCE_MONITOR = ResilienceMonitor()
+
+
 class ProfilerGate:
     """Start/stop ``jax.profiler`` around a window of training updates."""
 

@@ -2,31 +2,40 @@
 # The one-command CPU test gate (runs in CI — .github/workflows/cpu-tests.yaml —
 # and locally).  Parity role model: the reference's pinned suite
 # (/root/reference/.github/workflows/cpu-tests.yaml:25-65 + tests/run_tests.py).
+#
+# Every stage runs under its own WALL BUDGET (`timeout`): a wedged stage —
+# exactly the failure class the resilience layer exists for — kills that
+# stage with rc=124 instead of hanging the whole gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/6: unit + E2E dry-run suite ==="
-python -m pytest tests/ -x -q --ignore=tests/test_regression --ignore=tests/test_checkpoint
+echo "=== stage 1/8: unit + E2E dry-run suite (budget 1500s) ==="
+timeout -k 15 1500 python -m pytest tests/ -x -q \
+  --ignore=tests/test_regression --ignore=tests/test_checkpoint \
+  --ignore=tests/test_resilience
 
-echo "=== stage 2/6: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) ==="
-python -m pytest tests/test_checkpoint -q
+echo "=== stage 2/8: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) (budget 420s) ==="
+timeout -k 15 420 python -m pytest tests/test_checkpoint -q
 
-echo "=== stage 3/6: numeric regression (goldens + reference fixture) ==="
-python -m pytest tests/test_regression -q
+echo "=== stage 3/8: chaos drills (fault injection: env storm, SIGKILL+quarantine resume, serve under faults) (budget 600s) ==="
+timeout -k 15 600 python -m pytest tests/test_resilience -q
 
-echo "=== stage 4/6: multichip dryrun (virtual 8-device mesh) ==="
-python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+echo "=== stage 4/8: numeric regression (goldens + reference fixture) (budget 600s) ==="
+timeout -k 15 600 python -m pytest tests/test_regression -q
 
-echo "=== stage 5/6: 2-D (data x model) mesh training cell + compile budget ==="
+echo "=== stage 5/8: multichip dryrun (virtual 8-device mesh) (budget 900s) ==="
+timeout -k 15 900 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+echo "=== stage 6/8: 2-D (data x model) mesh training cell + compile budget (budget 600s) ==="
 # dreamer_v3 end-to-end through the CLI on a 2x4 fake-device mesh: the
 # partition-rules (TP) path with the recompile detector as a hard gate —
 # algo.max_recompiles=1 means each compile-once program (train phase, player
 # step) may compile at most twice (first compile free + the prefill/train
 # signature split); a TP path that regressed to recompile-per-step dies here.
-python - <<'PY'
+timeout -k 15 600 python - <<'PY'
 from sheeprl_tpu.cli import run
 run([
     "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy",
@@ -46,10 +55,13 @@ run([
     "checkpoint.every=0", "checkpoint.save_last=False", "buffer.memmap=False",
     "metric.log_level=0", "log_dir=/tmp/run_ci_tp_logs", "print_config=False",
 ])
-print("stage 5/6 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
+print("stage 6/8 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
 PY
 
-echo "=== stage 6/6: policy-serving smoke (HTTP server + batched requests + clean shutdown) ==="
-python tests/serve_smoke.py
+echo "=== stage 7/8: policy-serving smoke (HTTP server + batched requests + clean shutdown) (budget 600s) ==="
+timeout -k 15 600 python tests/serve_smoke.py
+
+echo "=== stage 8/8: fault-injection zero-overhead gate (empty plan steady-state within 2%) (budget 600s) ==="
+timeout -k 15 600 env BENCH_TARGET=fault_overhead python bench.py
 
 echo "CI gate: ALL GREEN"

@@ -89,6 +89,61 @@ class TestRestartOnException:
             for _ in range(5):
                 env.step(env.action_space.sample())
 
+    # -- restart-budget WINDOW semantics (not just the count) ----------------
+    def _crash_storm_env(self):
+        class AlwaysCrash(DiscreteDummyEnv):
+            def step(self, action):
+                raise RuntimeError("boom")
+
+        return AlwaysCrash
+
+    def test_storm_within_window_exhausts_budget(self, monkeypatch):
+        """A storm of crashes inside one window burns max_restarts and the
+        (max_restarts+1)-th crash propagates — the budget is a rate limit,
+        and a persistently broken env must fail the run."""
+        import sheeprl_tpu.envs.wrappers as wrappers
+
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(wrappers.time, "monotonic", lambda: clock["t"])
+        env = RestartOnException(self._crash_storm_env(), max_restarts=3, window=60.0)
+        env.reset()
+        for _ in range(3):  # three restarts, all at t=1000 (inside the window)
+            env.step(env.action_space.sample())
+        with pytest.raises(RuntimeError, match="3 times within"):
+            env.step(env.action_space.sample())
+
+    def test_sparse_crashes_outside_window_keep_budget_fresh(self, monkeypatch):
+        """Crashes spaced wider than the window never accumulate: each one
+        falls out of the sliding window before the next, so an occasionally
+        flaky env can restart forever without tripping the budget."""
+        import sheeprl_tpu.envs.wrappers as wrappers
+
+        clock = {"t": 1000.0}
+        monkeypatch.setattr(wrappers.time, "monotonic", lambda: clock["t"])
+        env = RestartOnException(self._crash_storm_env(), max_restarts=2, window=60.0)
+        env.reset()
+        for _ in range(10):  # 10 restarts, 61s apart — far beyond the budget
+            obs, r, term, trunc, info = env.step(env.action_space.sample())
+            assert info.get("restart_on_exception") is True
+            clock["t"] += 61.0
+
+    def test_budget_refills_as_old_restarts_age_out(self, monkeypatch):
+        """Partial aging: after a burst, one restart falling out of the
+        window frees exactly one slot."""
+        import sheeprl_tpu.envs.wrappers as wrappers
+
+        clock = {"t": 0.0}
+        monkeypatch.setattr(wrappers.time, "monotonic", lambda: clock["t"])
+        env = RestartOnException(self._crash_storm_env(), max_restarts=2, window=60.0)
+        env.reset()
+        env.step(env.action_space.sample())  # restart 1 at t=0
+        clock["t"] = 30.0
+        env.step(env.action_space.sample())  # restart 2 at t=30 — budget full
+        clock["t"] = 61.0  # restart 1 aged out, one slot free again
+        env.step(env.action_space.sample())  # restart 3 at t=61 — allowed
+        with pytest.raises(RuntimeError):  # t=61: restarts 2+3 in window
+            env.step(env.action_space.sample())
+
 
 class TestMakeEnv:
     def _cfg(self, extra=()):

@@ -1,0 +1,171 @@
+"""Chaos drill (b): SIGKILL a training run mid-checkpoint-write, corrupt the
+newest COMMITTED snapshot on disk, then relaunch with
+``checkpoint.resume_from=auto`` and assert:
+
+* the torn snapshot (shards written, COMMIT missing — the injected hang
+  parks the writer exactly in that window, so kill -9 lands mid-protocol)
+  is never eligible for resume;
+* the CRC-corrupted COMMITTED snapshot is quarantined
+  (``step_* → step_*.corrupt``), NOT loaded;
+* the run resumes from the last INTACT committed step and completes.
+"""
+
+import glob
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from sheeprl_tpu.checkpoint import list_checkpoints
+from sheeprl_tpu.checkpoint.protocol import checkpoint_step, shard_name
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_COMMON = [
+    "exp=sac",
+    "env=dummy",
+    "env.id=continuous_dummy",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "env.max_episode_steps=8",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "algo.per_rank_batch_size=4",
+    "algo.learning_starts=4",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.run_test=False",
+    "checkpoint.every=20",
+    "buffer.size=512",
+    "buffer.memmap=False",
+    "buffer.checkpoint=True",
+    "metric.log_level=0",
+    "root_dir=chaos_resume",
+    "print_config=False",
+]
+
+# the 3rd commit hangs BETWEEN the shard writes and the COMMIT marker: the
+# parent's kill -9 then lands deterministically mid-protocol, leaving the
+# canonical torn snapshot
+HANG_COMMIT_PLAN = json.dumps(
+    {"plan": [{"site": "checkpoint.commit", "kind": "hang", "at": 3, "seconds": 300.0}]}
+)
+
+
+def _launch(tmp_path, run_name, total_steps, fault_plan=None, extra=()):
+    code = "import sys; from sheeprl_tpu.cli import run; run(sys.argv[1:])"
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "PYTHONPATH": _REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    env.pop("SHEEPRL_FAULT_PLAN", None)
+    if fault_plan is not None:
+        env["SHEEPRL_FAULT_PLAN"] = fault_plan
+    args = [
+        *_COMMON,
+        f"algo.total_steps={total_steps}",
+        f"log_dir={tmp_path}/logs",
+        f"run_name={run_name}",
+        *extra,
+    ]
+    return subprocess.Popen(
+        [sys.executable, "-c", code, *args],
+        env=env,
+        cwd=_REPO,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+
+
+def _committed(tmp_path):
+    out = []
+    for root in glob.glob(f"{tmp_path}/logs/**/checkpoint", recursive=True):
+        out.extend(list_checkpoints(root))
+    return sorted(out, key=checkpoint_step)
+
+
+def _torn(tmp_path):
+    """Snapshot dirs whose shards landed but whose COMMIT never did — the
+    injected commit hang parks the writer exactly in that window."""
+    out = []
+    for root in glob.glob(f"{tmp_path}/logs/**/checkpoint", recursive=True):
+        out.extend(
+            d
+            for d in list_checkpoints(root, committed_only=False)
+            if (d / shard_name(0)).exists() and not (d / "COMMIT").exists()
+        )
+    return out
+
+
+def test_sigkill_mid_write_quarantine_and_auto_resume(tmp_path):
+    # ---- phase 1: train, hang the 3rd commit, kill -9 mid-protocol --------
+    proc = _launch(tmp_path, "run_a", total_steps=100000, fault_plan=HANG_COMMIT_PLAN)
+    try:
+        deadline = time.monotonic() + 240
+        while time.monotonic() < deadline:
+            if len(_committed(tmp_path)) >= 2 and _torn(tmp_path):
+                break  # 2 durable commits + the hung 3rd (shards, no COMMIT)
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"training died early (rc={proc.returncode}):\n{proc.stdout.read()}"
+                )
+            time.sleep(0.25)
+        else:
+            raise AssertionError(
+                f"never reached 2 commits + a parked 3rd; have "
+                f"{len(_committed(tmp_path))} commits, torn={_torn(tmp_path)}"
+            )
+        os.kill(proc.pid, signal.SIGKILL)  # no grace, no final save
+        proc.wait(30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.stdout.close()
+
+    committed = _committed(tmp_path)
+    assert len(committed) >= 2
+    torn = _torn(tmp_path)
+    assert torn, "the injected commit hang must leave a torn snapshot"
+    survivor_step = checkpoint_step(committed[-2])
+    newest_step = checkpoint_step(committed[-1])
+
+    # ---- phase 2: bit-rot the newest COMMITTED snapshot -------------------
+    shard = committed[-1] / shard_name(0)
+    blob = bytearray(shard.read_bytes())
+    blob[len(blob) // 2] ^= 0xFF
+    shard.write_bytes(bytes(blob))
+
+    # ---- phase 3: auto-resume must quarantine the rot and take the last
+    # intact commit, then run to completion ---------------------------------
+    resume_steps = newest_step + 40  # a bit more work, then a clean finish
+    proc = _launch(
+        tmp_path, "run_b", total_steps=resume_steps,
+        extra=("checkpoint.resume_from=auto",),
+    )
+    out = ""
+    try:
+        out = proc.communicate(timeout=300)[0]
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, f"resumed run failed:\n{out}"
+
+    # the damaged snapshot was quarantined, not loaded
+    quarantined = glob.glob(f"{tmp_path}/logs/**/step_*.corrupt", recursive=True)
+    assert quarantined, f"no quarantined snapshot; output:\n{out}"
+    assert f"step_{newest_step:012d}.corrupt" in quarantined[0]
+    # resume landed on the last INTACT committed step
+    assert f"resume_from=auto -> " in out
+    assert f"step_{survivor_step:012d}" in out.split("resume_from=auto -> ", 1)[1].splitlines()[0]
+    # the torn snapshot stayed uncommitted and was never resumed from
+    assert f"step_{checkpoint_step(torn[0]):012d}" not in out.split("resume_from=auto -> ", 1)[1].splitlines()[0]
+    # and the resumed run itself committed new progress past the survivor
+    final = _committed(tmp_path)
+    assert checkpoint_step(final[-1]) > survivor_step

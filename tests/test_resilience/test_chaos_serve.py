@@ -1,0 +1,212 @@
+"""Chaos drill (c): a serve load test under an active fault plan.
+
+* injected HTTP faults (500s + latency) on ``serve.http`` must drop ZERO
+  in-flight requests — the client's retry/backoff absorbs them;
+* a poisoned (corrupt) newer commit must open the reload circuit breaker,
+  be quarantined, and leave the OLD params serving — ``/healthz`` reports
+  ``degraded: true`` while ``/v1/act`` keeps answering.
+"""
+
+import json
+import shutil
+import threading
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.resilience import faults
+
+pytestmark = pytest.mark.filterwarnings("ignore::RuntimeWarning")
+
+
+@pytest.fixture(scope="module")
+def ppo_ckpt(tmp_path_factory):
+    from sheeprl_tpu.cli import run
+    from tests.ckpt_utils import find_checkpoints
+
+    log_dir = tmp_path_factory.mktemp("chaos_serve")
+    run(
+        [
+            "exp=ppo",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            "dry_run=True",
+            "env.num_envs=2",
+            "env.sync_env=True",
+            "env.capture_video=False",
+            "fabric.devices=1",
+            "fabric.accelerator=cpu",
+            "metric.log_level=0",
+            "checkpoint.every=1",
+            "buffer.memmap=False",
+            "algo.learning_starts=0",
+            f"log_dir={log_dir}",
+            "print_config=False",
+            "algo.run_test=False",
+        ]
+    )
+    ckpts = find_checkpoints(str(log_dir))
+    assert ckpts
+    return ckpts[-1]
+
+
+def _service(ppo_ckpt, overrides=()):
+    from sheeprl_tpu.serve.service import PolicyService
+
+    return PolicyService.from_checkpoint(
+        ppo_ckpt,
+        [
+            "serve.watch_commits=false",  # polls driven explicitly by the test
+            "serve.max_wait_ms=2.0",
+            "serve.reload_failure_threshold=2",
+            "serve.reload_breaker_reset_s=30.0",
+            *overrides,
+        ],
+    )
+
+
+def test_load_test_under_fault_plan_drops_nothing(ppo_ckpt):
+    """16 client threads × 8 requests against a server whose HTTP layer is
+    actively failing (every 7th request 500s, ~10% get +50 ms latency):
+    every single request must still produce an action."""
+    from sheeprl_tpu.serve.client import PolicyClient
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    service = _service(ppo_ckpt)
+    obs = {
+        k: np.zeros(shape, dtype=dt)
+        for k, (shape, dt) in service.player.obs_spec.items()
+    }
+    faults.install_plan(
+        faults.FaultPlan.from_specs(
+            [
+                {"site": "serve.http", "kind": "raise", "every": 7},
+                {"site": "serve.http", "kind": "latency", "p": 0.1, "seconds": 0.05},
+            ],
+            seed=13,
+        )
+    )
+    try:
+        with PolicyServer(service) as server:
+            client_errors = []
+            actions = []
+            lock = threading.Lock()
+
+            def worker(wid):
+                client = PolicyClient(server.url, timeout=30.0, retries=5, retry_base_s=0.05)
+                for _ in range(8):
+                    try:
+                        a = client.act(obs)
+                        with lock:
+                            actions.append(a)
+                    except Exception as e:  # a dropped request
+                        with lock:
+                            client_errors.append(e)
+
+            threads = [threading.Thread(target=worker, args=(i,)) for i in range(16)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            stats = service.stats()
+    finally:
+        faults.clear_plan()
+
+    assert client_errors == [], f"dropped {len(client_errors)}: {client_errors[:3]}"
+    assert len(actions) == 16 * 8  # zero in-flight requests lost
+    assert stats["served"] >= 16 * 8
+    # the storm really happened: injected 500s were retried, not absorbed
+    from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+
+    totals = RESILIENCE_MONITOR.totals()
+    assert totals["injected_by_site"].get("serve.http", 0) > 0
+    assert totals["retries"] > 0
+
+
+def test_poisoned_commit_opens_breaker_quarantines_and_keeps_serving(ppo_ckpt):
+    import pathlib
+
+    from sheeprl_tpu.checkpoint.protocol import checkpoint_step, shard_name
+    from sheeprl_tpu.serve.client import PolicyClient
+    from sheeprl_tpu.serve.server import PolicyServer
+
+    service = _service(ppo_ckpt)
+    served_step = service.store.step
+    ckpt_dir = pathlib.Path(ppo_ckpt)
+    root = ckpt_dir.parent
+
+    # forge a NEWER commit whose shard bytes are garbage (bit rot / torn
+    # write that still carries a COMMIT marker)
+    poison = root / f"step_{served_step + 1000:012d}"
+    shutil.copytree(ckpt_dir, poison)
+    shard = poison / shard_name(0)
+    blob = bytearray(shard.read_bytes())
+    blob[len(blob) // 3] ^= 0xFF
+    blob[len(blob) // 2] ^= 0xFF
+    shard.write_bytes(bytes(blob))
+
+    obs = {
+        k: np.zeros(shape, dtype=dt)
+        for k, (shape, dt) in service.player.obs_spec.items()
+    }
+    with PolicyServer(service) as server:
+        client = PolicyClient(server.url)
+        assert client.health()["degraded"] is False
+
+        # failure_threshold=2: two failed loads of the same poisoned step →
+        # breaker opens + snapshot quarantined; old params keep serving
+        assert service.watcher.poll_once() is None
+        assert service.watcher.poll_once() is None
+
+        health = client.health()
+        assert health["degraded"] is True
+        assert health["reload_breaker"]["state"] == "open"
+        stats = client.stats()
+        assert stats["degraded"] is True
+        assert stats["quarantined"] == 1
+        assert stats["checkpoint_step"] == served_step  # old params still in
+
+        # the poison is out of the discovery namespace, kept for forensics
+        assert not poison.exists()
+        corrupt = list(root.glob("step_*.corrupt*"))
+        assert len(corrupt) == 1 and checkpoint_step(corrupt[0]) == -1
+
+        # and the server still answers with the old params
+        a = client.act(obs)
+        assert np.asarray(a).size >= 1
+        assert client.last_checkpoint_step == served_step
+
+
+def test_hot_reload_still_works_after_quarantine(ppo_ckpt):
+    """After the poison is quarantined, a GOOD newer commit must hot-swap
+    once the breaker's cool-down lets the half-open probe through."""
+    import pathlib
+
+    from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+
+    service = _service(ppo_ckpt, overrides=("serve.reload_breaker_reset_s=0.05",))
+    served_step = service.store.step
+    ckpt_dir = pathlib.Path(ppo_ckpt)
+    root = ckpt_dir.parent
+
+    poison = root / f"step_{served_step + 500:012d}"
+    shutil.copytree(ckpt_dir, poison)
+    (poison / "shard_r00000.pkl").write_bytes(b"not a pickle")
+
+    service.start(warm=False)
+    try:
+        assert service.watcher.poll_once() is None
+        assert service.watcher.poll_once() is None  # threshold=2 → quarantined
+        assert not poison.exists()
+
+        good = root / f"step_{served_step + 600:012d}"
+        shutil.copytree(ckpt_dir, good)
+        import time
+
+        time.sleep(0.06)  # breaker cool-down → half-open probe allowed
+        gen = service.watcher.poll_once()
+        assert gen is not None
+        assert service.store.step == served_step + 600
+        assert service.watcher.degraded is False  # probe success closed it
+    finally:
+        service.stop()

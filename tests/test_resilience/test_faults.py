@@ -1,0 +1,202 @@
+"""Unit tests for the fault-plan engine (sheeprl_tpu/resilience/faults.py)."""
+
+import json
+
+import pytest
+
+from sheeprl_tpu.resilience import faults
+from sheeprl_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    active_plan,
+    clear_plan,
+    fault_bytes,
+    fault_point,
+    install_from_config,
+    install_from_env,
+    install_plan,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    clear_plan()
+    yield
+    clear_plan()
+
+
+class TestPlanBuild:
+    def test_empty_plan_compiles_to_none(self):
+        assert install_plan(FaultPlan.from_specs([])) is None
+        assert active_plan() is None
+        # the disabled hot path: must be callable with zero effect
+        fault_point("env.step")
+        assert fault_bytes("checkpoint.write_shard", b"abc") == b"abc"
+
+    def test_unknown_site_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault site"):
+            FaultPlan.from_specs([{"site": "env.stpe", "kind": "raise", "at": 1}])
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultPlan.from_specs([{"site": "env.step", "kind": "explode", "at": 1}])
+
+    def test_missing_schedule_rejected(self):
+        with pytest.raises(ValueError, match="no schedule"):
+            FaultPlan.from_specs([{"site": "env.step", "kind": "raise"}])
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault spec fields"):
+            FaultPlan.from_specs([{"site": "env.step", "kind": "raise", "att": 1}])
+
+    def test_bad_exception_name_rejected(self):
+        with pytest.raises(ValueError, match="not a builtin exception"):
+            FaultPlan.from_specs(
+                [{"site": "env.step", "kind": "raise", "at": 1, "exception": "Nope"}]
+            )
+
+
+class TestSchedules:
+    def test_at_fires_exactly_once(self):
+        install_plan(FaultPlan.from_specs([{"site": "env.step", "kind": "raise", "at": 3}]))
+        fault_point("env.step")
+        fault_point("env.step")
+        with pytest.raises(InjectedFault):
+            fault_point("env.step")
+        for _ in range(10):
+            fault_point("env.step")  # never again
+
+    def test_every_fires_periodically_with_max_fires(self):
+        install_plan(
+            FaultPlan.from_specs(
+                [{"site": "env.step", "kind": "raise", "every": 3, "max_fires": 2}]
+            )
+        )
+        fired = 0
+        for _ in range(12):
+            try:
+                fault_point("env.step")
+            except InjectedFault:
+                fired += 1
+        assert fired == 2
+
+    def test_p_schedule_is_seeded_deterministic(self):
+        def run(seed):
+            plan = FaultPlan.from_specs(
+                [{"site": "env.step", "kind": "raise", "p": 0.3}], seed=seed
+            )
+            install_plan(plan)
+            pattern = []
+            for _ in range(50):
+                try:
+                    fault_point("env.step")
+                    pattern.append(0)
+                except InjectedFault:
+                    pattern.append(1)
+            return pattern
+
+        a, b = run(7), run(7)
+        assert a == b  # same seed, same storm
+        assert run(8) != a  # different seed, different storm
+        assert sum(a) > 0  # p=0.3 over 50 draws fires at least once
+
+    def test_sites_are_independent(self):
+        install_plan(
+            FaultPlan.from_specs([{"site": "env.reset", "kind": "raise", "at": 1}])
+        )
+        fault_point("env.step")  # not targeted
+        with pytest.raises(InjectedFault):
+            fault_point("env.reset")
+
+    def test_custom_exception_class(self):
+        install_plan(
+            FaultPlan.from_specs(
+                [{"site": "checkpoint.write_shard", "kind": "raise", "at": 1,
+                  "exception": "OSError", "message": "disk on fire"}]
+            )
+        )
+        with pytest.raises(OSError, match="disk on fire"):
+            fault_point("checkpoint.write_shard")
+
+
+class TestByteFaults:
+    def test_corrupt_changes_bytes_keeps_length(self):
+        install_plan(
+            FaultPlan.from_specs(
+                [{"site": "checkpoint.write_shard", "kind": "corrupt", "at": 1}]
+            )
+        )
+        payload = bytes(range(256)) * 4
+        out = fault_bytes("checkpoint.write_shard", payload)
+        assert len(out) == len(payload) and out != payload
+        # next call: untouched
+        assert fault_bytes("checkpoint.write_shard", payload) == payload
+
+    def test_truncate_halves_payload(self):
+        install_plan(
+            FaultPlan.from_specs(
+                [{"site": "checkpoint.write_shard", "kind": "truncate", "at": 1}]
+            )
+        )
+        out = fault_bytes("checkpoint.write_shard", b"x" * 100)
+        assert len(out) == 50
+
+    def test_corrupt_at_value_site_rejected(self):
+        # a byte fault at a value site would silently never act — reject at
+        # plan build, like every other way to disarm a drill by typo
+        with pytest.raises(ValueError, match="byte-payload sites"):
+            FaultPlan.from_specs([{"site": "env.step", "kind": "corrupt", "every": 1}])
+
+
+class TestInstallPaths:
+    def test_env_var_roundtrip(self, monkeypatch):
+        plan = FaultPlan.from_specs(
+            [{"site": "serve.http", "kind": "latency", "every": 2, "seconds": 0.01}],
+            seed=5,
+        )
+        monkeypatch.setenv(faults.ENV_VAR, plan.to_json())
+        installed = install_from_env()
+        assert installed is not None and installed.sites == ["serve.http"]
+        assert installed.seed == 5
+
+    def test_env_var_bare_list(self, monkeypatch):
+        monkeypatch.setenv(
+            faults.ENV_VAR,
+            json.dumps([{"site": "env.step", "kind": "raise", "at": 1}]),
+        )
+        assert install_from_env().sites == ["env.step"]
+
+    def test_install_from_config_disabled(self):
+        assert install_from_config({"fault_injection": {"enabled": False, "plan": [
+            {"site": "env.step", "kind": "raise", "at": 1}]}}) is None
+
+    def test_install_from_config_enabled(self):
+        plan = install_from_config(
+            {
+                "seed": 3,
+                "fault_injection": {
+                    "enabled": True,
+                    "seed": None,
+                    "plan": [{"site": "env.step", "kind": "raise", "at": 1}],
+                },
+            }
+        )
+        assert plan is not None and plan.sites == ["env.step"]
+        assert plan.seed == 3  # falls back to the run seed
+
+    def test_env_var_wins_over_config(self, monkeypatch):
+        monkeypatch.setenv(
+            faults.ENV_VAR,
+            json.dumps([{"site": "serve.http", "kind": "raise", "at": 1}]),
+        )
+        plan = install_from_config(
+            {"fault_injection": {"enabled": True,
+                                 "plan": [{"site": "env.step", "kind": "raise", "at": 1}]}}
+        )
+        assert plan.sites == ["serve.http"]
+
+    def test_targets_prefix(self):
+        plan = FaultPlan.from_specs([{"site": "env.step", "kind": "raise", "at": 1}])
+        assert plan.targets("env.")
+        assert not plan.targets("serve.")

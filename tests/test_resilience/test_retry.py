@@ -1,0 +1,236 @@
+"""Unit tests for the recovery primitives (sheeprl_tpu/resilience/retry.py)
+and the hardened checkpoint writer paths that use them."""
+
+import threading
+import time
+
+import pytest
+
+from sheeprl_tpu.checkpoint.writer import AsyncCheckpointWriter
+from sheeprl_tpu.resilience.retry import CircuitBreaker, Watchdog, retry
+
+
+class TestRetry:
+    def test_succeeds_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("blip")
+            return "ok"
+
+        assert retry(flaky, attempts=5, base_s=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_gives_up_after_attempts(self):
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("gone")
+
+        with pytest.raises(OSError, match="gone"):
+            retry(dead, attempts=3, base_s=0.001)
+        assert len(calls) == 3
+
+    def test_non_transient_propagates_immediately(self):
+        calls = []
+
+        def wrong():
+            calls.append(1)
+            raise ValueError("bug, not blip")
+
+        with pytest.raises(ValueError):
+            retry(wrong, attempts=5, base_s=0.001, retry_on=(OSError,))
+        assert len(calls) == 1
+
+    def test_should_retry_filter(self):
+        calls = []
+
+        def teapot():
+            calls.append(1)
+            raise OSError(418, "teapot")
+
+        with pytest.raises(OSError):
+            retry(
+                teapot,
+                attempts=5,
+                base_s=0.001,
+                should_retry=lambda e: e.args[0] != 418,
+            )
+        assert len(calls) == 1
+
+    def test_deadline_bounds_total_time(self):
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry(
+                lambda: (_ for _ in ()).throw(OSError("x")),
+                attempts=100,
+                base_s=0.5,
+                multiplier=1.0,
+                jitter=0.0,
+                deadline_s=0.3,
+            )
+        assert time.monotonic() - t0 < 1.0
+
+    def test_backoff_grows(self):
+        sleeps = []
+        calls = []
+
+        def dead():
+            calls.append(1)
+            raise OSError("x")
+
+        with pytest.raises(OSError):
+            retry(
+                dead,
+                attempts=4,
+                base_s=0.01,
+                multiplier=2.0,
+                jitter=0.0,
+                on_retry=lambda n, e, s: sleeps.append(s),
+            )
+        assert sleeps == [0.01, 0.02, 0.04]
+
+
+class TestWatchdog:
+    def test_detects_stall_once_and_rearms_on_beat(self):
+        stalls = []
+        wd = Watchdog(0.08, on_stall=stalls.append, interval_s=0.02)
+        try:
+            wd.arm()
+            time.sleep(0.3)
+            assert len(stalls) == 1  # fires once per stall, not per check
+            wd.beat()  # progress: re-arms
+            time.sleep(0.3)
+            assert len(stalls) == 2
+        finally:
+            wd.close()
+
+    def test_no_stall_while_beating_or_disarmed(self):
+        stalls = []
+        wd = Watchdog(0.1, on_stall=stalls.append, interval_s=0.02)
+        try:
+            wd.arm()
+            for _ in range(10):
+                wd.beat()
+                time.sleep(0.02)
+            wd.disarm()
+            time.sleep(0.25)
+            assert stalls == []
+        finally:
+            wd.close()
+
+    def test_context_manager(self):
+        stalls = []
+        wd = Watchdog(10.0, on_stall=stalls.append, interval_s=0.02)
+        try:
+            with wd.watching() as w:
+                assert w is wd
+            time.sleep(0.1)
+            assert stalls == []
+        finally:
+            wd.close()
+
+
+class TestCircuitBreaker:
+    def test_open_half_open_close_cycle(self):
+        b = CircuitBreaker(failure_threshold=2, reset_timeout_s=0.1)
+        assert b.state == CircuitBreaker.CLOSED and b.allow()
+        b.record_failure()
+        assert b.state == CircuitBreaker.CLOSED
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN and not b.allow()
+        time.sleep(0.12)
+        assert b.state == CircuitBreaker.HALF_OPEN and b.allow()
+        b.record_success()
+        assert b.state == CircuitBreaker.CLOSED
+        assert b.failures == 0
+
+    def test_half_open_failure_reopens(self):
+        b = CircuitBreaker(failure_threshold=1, reset_timeout_s=0.05)
+        b.record_failure()
+        assert not b.allow()
+        time.sleep(0.06)
+        assert b.allow()  # half-open probe
+        b.record_failure()
+        assert b.state == CircuitBreaker.OPEN  # immediately, one strike
+        assert b.opens == 2
+
+    def test_snapshot_shape(self):
+        b = CircuitBreaker(failure_threshold=3, name="t")
+        b.record_failure()
+        snap = b.snapshot()
+        assert snap == {"state": "closed", "failures": 1, "threshold": 3, "opens": 0}
+
+
+class TestHardenedWriter:
+    def test_transient_io_error_retried_not_parked(self):
+        w = AsyncCheckpointWriter(queue_size=2, io_retries=3, io_retry_base_s=0.001)
+        calls = []
+
+        def job():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("blip")
+            return 7
+
+        w.submit(job)
+        assert w.flush(10.0)
+        assert len(calls) == 3
+        w.close(5.0)  # no parked error to re-raise
+
+    def test_exhausted_retries_park_and_reraise(self):
+        w = AsyncCheckpointWriter(queue_size=2, io_retries=2, io_retry_base_s=0.001)
+        w.submit(lambda: (_ for _ in ()).throw(OSError("dead disk")))
+        with pytest.raises(RuntimeError, match="async checkpoint save failed"):
+            w.flush(10.0)
+        w.close(5.0)
+
+    def test_non_io_error_not_retried(self):
+        w = AsyncCheckpointWriter(queue_size=2, io_retries=5, io_retry_base_s=0.001)
+        calls = []
+
+        def job():
+            calls.append(1)
+            raise ValueError("bug")
+
+        w.submit(job)
+        with pytest.raises(RuntimeError):
+            w.flush(10.0)
+        assert len(calls) == 1
+        w.close(5.0)
+
+    def test_close_returns_with_wedged_worker(self):
+        """The close-on-wedged-worker satellite: a worker stuck in a job
+        (dead disk) must not hang interpreter shutdown — close() drains via
+        the bounded waits, warns about the abandoned job, and returns."""
+        release = threading.Event()
+        w = AsyncCheckpointWriter(queue_size=1, io_retries=1, hang_warn_s=0)
+
+        def wedged():
+            release.wait(30.0)  # simulates a write stuck on dead storage
+            return 0
+
+        w.submit(wedged)
+        w.submit(lambda: 0)  # fills the bounded queue behind the stuck job
+        t0 = time.monotonic()
+        with pytest.warns(RuntimeWarning, match="abandoning the daemon thread"):
+            w.close(timeout_s=0.3)
+        assert time.monotonic() - t0 < 10.0  # returned, did not hang
+        release.set()  # let the daemon thread finish so the test exits clean
+
+    def test_writer_watchdog_flags_wedged_job(self):
+        from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+
+        before = RESILIENCE_MONITOR.totals()["stalls"]
+        release = threading.Event()
+        w = AsyncCheckpointWriter(queue_size=1, io_retries=1, hang_warn_s=0.05)
+        with pytest.warns(RuntimeWarning, match="no progress"):
+            w.submit(lambda: release.wait(1.0))
+            time.sleep(0.4)
+        release.set()
+        w.flush(5.0)
+        w.close(5.0)
+        assert RESILIENCE_MONITOR.totals()["stalls"] > before

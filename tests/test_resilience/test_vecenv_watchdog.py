@@ -1,0 +1,129 @@
+"""StepDeadlineVectorEnv: hang detection, teardown/recreate, restart budget."""
+
+import os
+
+import gymnasium as gym
+import numpy as np
+import pytest
+from gymnasium.vector import AutoresetMode
+
+from sheeprl_tpu.utils.env import StepDeadlineVectorEnv
+
+
+class SometimesHangs(gym.Env):
+    """Hangs on the step number given by the HANG_AT_STEP env var (the env
+    var crosses the fork into AsyncVectorEnv workers)."""
+
+    observation_space = gym.spaces.Box(-1, 1, (3,), np.float32)
+    action_space = gym.spaces.Discrete(2)
+
+    def __init__(self):
+        self.t = 0
+
+    def reset(self, seed=None, options=None):
+        return np.zeros(3, np.float32), {}
+
+    def step(self, action):
+        self.t += 1
+        if self.t == int(os.environ.get("HANG_AT_STEP", -1)):
+            import time
+
+            time.sleep(60.0)
+        return np.full(3, self.t, np.float32), 1.0, False, False, {}
+
+
+def _make(n=2):
+    return gym.vector.AsyncVectorEnv(
+        [SometimesHangs for _ in range(n)], autoreset_mode=AutoresetMode.SAME_STEP
+    )
+
+
+def test_normal_stepping_passes_through(monkeypatch):
+    monkeypatch.setenv("HANG_AT_STEP", "-1")
+    env = StepDeadlineVectorEnv(_make, deadline_s=5.0)
+    try:
+        obs, info = env.reset()
+        for i in range(3):
+            obs, r, term, trunc, info = env.step(np.zeros(2, np.int64))
+            assert "restart_on_exception" not in info
+            assert np.allclose(obs[:, 0], i + 1)
+        assert env.num_envs == 2
+        assert env.single_observation_space.shape == (3,)
+    finally:
+        env.close()
+
+
+def test_hang_detected_torn_down_and_flagged(monkeypatch):
+    monkeypatch.setenv("HANG_AT_STEP", "2")
+    env = StepDeadlineVectorEnv(_make, deadline_s=1.0, max_restarts=1, window_s=60.0)
+    try:
+        env.reset()
+        env.step(np.zeros(2, np.int64))  # t=1: fine
+        monkeypatch.setenv("HANG_AT_STEP", "-1")  # recreated workers behave
+        with pytest.warns(RuntimeWarning, match="vector env watchdog"):
+            obs, r, term, trunc, info = env.step(np.zeros(2, np.int64))  # t=2 hangs
+        # the break is reported on the RestartOnException contract so train
+        # loops patch their replay tails
+        assert np.all(np.asarray(info["restart_on_exception"]))
+        assert not term.any() and not trunc.any()
+        assert obs.shape == (2, 3)
+        # the recreated vector env serves steps again
+        obs, *_ = env.step(np.zeros(2, np.int64))
+        assert np.allclose(obs[:, 0], 1.0)  # fresh envs, t restarted
+    finally:
+        env.close()
+
+
+def test_restart_budget_exhaustion_raises(monkeypatch):
+    monkeypatch.setenv("HANG_AT_STEP", "1")  # every worker generation hangs
+    env = StepDeadlineVectorEnv(_make, deadline_s=0.5, max_restarts=1, window_s=600.0)
+    try:
+        env.reset()
+        with pytest.warns(RuntimeWarning, match="vector env watchdog"):
+            env.step(np.zeros(2, np.int64))  # restart 1: allowed
+        with pytest.raises(RuntimeError, match="giving up"):
+            env.step(np.zeros(2, np.int64))  # restart 2: budget spent
+    finally:
+        try:
+            env.close(terminate=True)
+        except Exception:
+            pass
+
+
+def test_reset_deadline_also_guarded(monkeypatch):
+    monkeypatch.setenv("HANG_AT_STEP", "-1")
+    env = StepDeadlineVectorEnv(_make, deadline_s=5.0)
+    try:
+        obs, info = env.reset()
+        assert obs.shape == (2, 3)
+    finally:
+        env.close()
+
+
+def test_vectorize_wires_watchdog_from_config():
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.utils.env import make_env, vectorize
+
+    cfg = compose(
+        [
+            "exp=ppo", "env=dummy", "env.id=discrete_dummy", "env.num_envs=2",
+            "env.sync_env=False", "env.capture_video=False",
+            "env.step_deadline_s=7.5", "metric.log_level=0",
+        ]
+    )
+    envs = vectorize(cfg, [make_env(cfg, 0, 0) for _ in range(2)])
+    try:
+        assert isinstance(envs, StepDeadlineVectorEnv)
+        assert envs._deadline == 7.5
+        obs, _ = envs.reset()
+        envs.step(np.zeros(2, np.int64))
+    finally:
+        envs.close()
+
+    # sync path: no watchdog (a hang there is the caller thread itself)
+    cfg.env.sync_env = True
+    sync_envs = vectorize(cfg, [make_env(cfg, 0, 0) for _ in range(2)])
+    try:
+        assert not isinstance(sync_envs, StepDeadlineVectorEnv)
+    finally:
+        sync_envs.close()
