@@ -28,11 +28,13 @@ from sheeprl_tpu.algos.ppo.agent import build_agent, evaluate_actions, sample_ac
 from sheeprl_tpu.algos.ppo.utils import (
     actions_for_env,
     normalize_obs_keys,
+    obs_to_np,
     prepare_obs,
     spaces_to_dims,
     test,
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_replay import stage_rollout, steady_guard
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -147,12 +149,15 @@ def main(fabric: Any, cfg: Any) -> None:
         p = optax.apply_updates(p, updates)
         return p, o_state, (pg, vl, e)
 
+    # rollout/last-obs staging is donated too (argnums 2/3): one dispatch
+    # consumes the staged block exactly once (see ppo.py)
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1, 2, 3),
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
+    guard_on = bool(cfg.buffer.get("transfer_guard", False))
 
     rollout_steps = int(cfg.algo.rollout_steps)
     sharded_envs, _ = fabric.env_sharding_plan(num_envs, "A2C")
@@ -233,23 +238,19 @@ def main(fabric: Any, cfg: Any) -> None:
                         aggregator.update("Game/ep_len_avg", ep_len)
 
         with timer("Time/train_time"):
-            from sheeprl_tpu.algos.ppo.ppo import _obs_to_device
-
+            # donated device staging: host-numpy normalization + EXPLICIT
+            # device_puts (data/device_replay.stage_rollout), rollout donated
+            # into the one-dispatch update (see ppo.py)
             local = rb.buffer
-            rollout = {}
-            for k in obs_keys:
-                rollout[k] = _obs_to_device(local[k], k in cnn_keys)
-            rollout["actions"] = jnp.asarray(local["actions"])
-            rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
-            rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
-            if sharded_envs:
-                # multi-host, each process contributes its local env rows
-                rollout = fabric.shard_batch(rollout, axis=1)
-                last_obs_dev = fabric.shard_batch(last_obs_dev, axis=0)
-            else:
-                rollout = fabric.replicate(rollout)
-            params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
+            host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
+            host_rollout["actions"] = np.asarray(local["actions"])
+            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
+            host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
+            last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
+            with steady_guard(guard_on and update > start_iter):
+                params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
             player_params = fabric.to_host(params)
 
         if cfg.algo.anneal_lr:

@@ -18,13 +18,15 @@ TPU-native architecture:
   (host CPU by default — zero device round-trips during interaction —
   or ``accelerator`` for thin links / big encoders), refreshed once per
   ratio window via a packed single-transfer param pull;
-* pixel replay can live ON DEVICE (``buffer.device_mirror``): sampled
-  sequences are gathered from a mirrored uint8 ring at host-drawn ring
-  coordinates, so training never ships pixel blocks; otherwise images
-  ship uint8 and normalize on device; batches shard over the mesh
-  ``data`` axis, params replicated (GSPMD gradient all-reduce), and the
-  Moments quantile is computed on the global batch — which IS the
-  reference's all-gathered Moments semantics (utils.py:56-63).
+* replay lives ON DEVICE (``buffer.device``, data/device_replay.py): the
+  whole ring — pixels included — is a mesh-sharded HBM pytree, and
+  sequence sampling compiles INTO the update dispatch, so steady-state
+  training performs zero H2D (supersedes the retired pixel-only
+  ``DeviceMirror``); on the host fallback images ship uint8 and normalize
+  on device; batches shard over the mesh ``data`` axis, params replicated
+  (GSPMD gradient all-reduce), and the Moments quantile is computed on
+  the global batch — which IS the reference's all-gathered Moments
+  semantics (utils.py:56-63).
 """
 
 from __future__ import annotations
@@ -47,7 +49,17 @@ from sheeprl_tpu.algos.dreamer_v3.utils import (
     test,
 )
 from sheeprl_tpu.algos.ppo.utils import actions_for_env, spaces_to_dims
-from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer, maybe_attach_mirror
+from sheeprl_tpu.data.buffers import EnvIndependentReplayBuffer, EpisodeBuffer, SequentialReplayBuffer
+from sheeprl_tpu.data.device_replay import (
+    DeviceReplay,
+    HostSpill,
+    estimate_step_bytes,
+    fit_hbm_window,
+    fused_sequence_train,
+    resolve_device_replay,
+    steady_guard,
+    update_chunks,
+)
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
@@ -65,10 +77,7 @@ from sheeprl_tpu.utils.timer import timer
 from sheeprl_tpu.utils.utils import (
     Ratio,
     merge_framestack,
-    mirror_hbm_bytes_per_update,
-    probe_bytes_per_update,
     save_configs,
-    window_chunks,
     window_scan,
 )
 
@@ -246,19 +255,71 @@ def dreamer_family_loop(
             else None,
         )
     else:
-        rb = EnvIndependentReplayBuffer(
-            max(int(cfg.buffer.size) // num_envs, seq_len * 2),
-            n_envs=num_envs,
-            buffer_cls=SequentialReplayBuffer,
-            memmap=cfg.buffer.memmap,
-            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+        capacity = max(int(cfg.buffer.size) // num_envs, seq_len * 2)
+        memmap_dir = (
+            os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None
         )
-    # device-resident pixel mirror: sampled pixel sequences are gathered on
-    # device instead of shipped per window (buffers.DeviceMirror); off for
-    # the EpisodeBuffer layout, which has no ring
-    mirror_on = isinstance(rb, EnvIndependentReplayBuffer) and maybe_attach_mirror(
-        rb, cfg, fabric.accelerator, obs_space, cnn_keys
-    )
+        # device-resident replay (data/device_replay.py): the WHOLE ring —
+        # pixels included — lives in HBM sharded over the mesh `data` axis,
+        # and sequence sampling compiles into the update dispatch.  This
+        # subsumes the retired per-device DeviceMirror (pixel-only,
+        # probe-gated) and the H2D window_chunks byte budget: in steady
+        # state nothing ships per update.  The EpisodeBuffer layout (no
+        # ring) and CPU runs keep the host-numpy path.
+        if resolve_device_replay(cfg, fabric.accelerator):
+            step_bytes = estimate_step_bytes(obs_space, obs_keys, extra_bytes=4 * (act_width + 4))
+            hbm_window, spill_needed = fit_hbm_window(
+                capacity, num_envs, step_bytes, cfg.buffer.get("hbm_window")
+            )
+            spill = (
+                HostSpill(capacity, num_envs, sequential=True, memmap=cfg.buffer.memmap, memmap_dir=memmap_dir)
+                if spill_needed
+                else None
+            )
+            rb = DeviceReplay(
+                hbm_window, num_envs, mesh=fabric.mesh, data_axis=fabric.data_axis, spill=spill
+            )
+        else:
+            rb = EnvIndependentReplayBuffer(
+                capacity,
+                n_envs=num_envs,
+                buffer_cls=SequentialReplayBuffer,
+                memmap=cfg.buffer.memmap,
+                memmap_dir=memmap_dir,
+            )
+    use_device_replay = isinstance(rb, DeviceReplay)
+    # fold on-device sequence sampling + block prep INTO the compiled update
+    # (data/device_replay.fused_sequence_train): the (U, L, B, *) block is
+    # gathered from the HBM ring inside the dispatch — the layout/uint8
+    # normalization contract of the host path is reproduced by _prep_blocks
+    train_phase_dev = None
+    if use_device_replay:
+        def _prep_blocks(b):
+            out = {}
+            for kk in cnn_keys:
+                x = b[kk]
+                if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
+                    x = merge_framestack(x, jnp)
+                out[kk] = x  # uint8 rides to the train phase; /255 on device
+            for kk in mlp_keys:
+                x = b[kk].astype(jnp.float32)
+                out[kk] = x.reshape(*x.shape[:3], -1)
+            out["actions"] = b["actions"].astype(jnp.float32)
+            for kk in ("rewards", "terminated", "is_first"):
+                out[kk] = b[kk][..., 0].astype(jnp.float32)
+            return out
+
+        train_phase_dev = fused_sequence_train(
+            fabric,
+            train_phase,
+            rb,
+            batch_size,
+            seq_len,
+            _prep_blocks,
+            name=f"{cfg.algo.name}.train_phase_device",
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+    guard_on = bool(cfg.buffer.get("transfer_guard", False)) and use_device_replay
     # a checkpoint only contains "rb" if it was saved with buffer.checkpoint
     # (or injected explicitly, e.g. P2E finetuning's load_from_exploration) —
     # so presence alone decides
@@ -301,8 +362,8 @@ def dreamer_family_loop(
     step_data["truncated"] = np.zeros((1, num_envs), np.float32)
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
-    bytes_per_update = None  # probed at the first train window (window_chunks)
-    mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
+    counter_dev = None  # device-resident grad-step counter (zero-copy path)
+    train_windows = 0  # completed dispatched windows (guards arm past warmup)
     # per-rank player key stream, advanced inside player_step; the main
     # `key` stays rank-identical for train dispatches
     player_key = jax.device_put(
@@ -436,69 +497,64 @@ def dreamer_family_loop(
         # ---------------- training -------------------------------------------
         if isinstance(rb, EpisodeBuffer):
             can_sample = len(rb) > seq_len and len(rb.buffer) > 0
+        elif use_device_replay:
+            can_sample = rb.can_sample_sequences(seq_len)
         else:
             can_sample = any(len(b) > seq_len for b in rb.buffer)
         if update >= learning_starts and can_sample:
             per_rank_gradient_steps = ratio(policy_step / fabric.world_size)
             if cfg.dry_run:
                 per_rank_gradient_steps = 1 if update == total_iters else 0
-            if per_rank_gradient_steps > 0:
+            if per_rank_gradient_steps > 0 and train_phase_dev is not None:
                 with timer("Time/train_time"):
-                    # burst windows (the first one repays every pre-training
-                    # env step at once) are split so no single sampled+shipped
-                    # (U, L, B, *) block can exceed the device byte budget —
-                    # see utils.window_chunks; steady-state windows stay
-                    # single-dispatch
-                    #
-                    # with the device mirror, pixel keys never cross the
-                    # host->device link: the host samples only the small
-                    # keys (and the ring coordinates), the device gathers
-                    # the pixel sequences from its mirrored ring
-                    sample_keys = (
-                        tuple(mlp_keys) + ("actions", "rewards", "terminated", "is_first")
-                        if mirror_on
-                        else None
-                    )
-                    if bytes_per_update is None:
-                        # probe only the keys that actually SHIP: sizing the
-                        # H2D chunking against pixel bytes the mirror never
-                        # ships would shrink chunks ~100x for nothing.  The
-                        # on-device gathered pixel block still consumes HBM —
-                        # budgeted separately below (window_chunks caps both).
-                        bytes_per_update = probe_bytes_per_update(
-                            rb, batch_size, sequence_length=seq_len, keys=sample_keys
-                        )
-                        if mirror_on:
-                            mirror_hbm_bytes = mirror_hbm_bytes_per_update(
-                                obs_space, cnn_keys, batch_size, rows=seq_len
+                    # zero-copy steady state: sequences are sampled from the
+                    # HBM ring INSIDE the compiled dispatch — nothing ships
+                    # H2D per update, and (optionally) the transfer guard
+                    # proves it past the first (warmup) window.  Windows are
+                    # still chunked into powers of two: distinct U values are
+                    # distinct executables, so bursts must reuse shapes
+                    # (data/device_replay.update_chunks).
+                    if counter_dev is None:
+                        # replicated on the mesh, matching the program's output
+                        # placement — a single-device stage would cost one
+                        # extra (first-window) executable on multi-device
+                        counter_dev = fabric.replicate(np.int32(grad_step_counter))
+                    player_params = psync.before_dispatch(player_params)
+                    with steady_guard(guard_on and train_windows > 0):
+                        # chunk cap honors BOTH budgets: compile reuse and the
+                        # HBM bytes the gathered (U, L, B, *) block materializes
+                        for u in update_chunks(
+                            per_rank_gradient_steps,
+                            bytes_per_update=rb.sampled_bytes_per_update(batch_size, seq_len),
+                        ):
+                            key, tk = jax.random.split(key)
+                            params, opt_state, counter_dev, last_metrics = train_phase_dev(
+                                params, opt_state, rb.buffers, rb.cursor, tk,
+                                counter_dev, n_samples=u,
                             )
-                        else:
-                            mirror_hbm_bytes = 0.0
+                            grad_step_counter += u
+                    train_windows += 1
+                    player_params = psync.after_dispatch(params, player_params)
+            elif per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    # host-numpy fallback (CPU runs, EpisodeBuffer): burst
+                    # windows (the first one repays every pre-training env
+                    # step at once) are chunked into powers of two so a burst
+                    # reuses a handful of compiled window shapes.
+                    #
                     # ONE player sync per ratio window, hoisted OUT of the
                     # chunk loop: a per-chunk refresh would pull the full
                     # player params D2H once per chunk (~6 s per pull over
                     # the tunnel x 257 burst chunks stalled the r5 capture)
                     player_params = psync.before_dispatch(player_params)
-                    for u in window_chunks(
-                        per_rank_gradient_steps,
-                        bytes_per_update,
-                        hbm_bytes_per_update=mirror_hbm_bytes,
-                    ):
+                    for u in update_chunks(per_rank_gradient_steps):
                         sample = rb.sample(
                             batch_size,
                             n_samples=u,
                             sequence_length=seq_len,
-                            keys=sample_keys,
                         )  # (U, L, batch, *)
                         blocks: Dict[str, jax.Array] = {}
                         for k in cnn_keys:
-                            if mirror_on:
-                                t_idx, e_idx = rb.last_sample_indices
-                                x = rb.mirror.gather(k, t_idx, e_idx)
-                                if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
-                                    x = merge_framestack(x, jnp)
-                                blocks[k] = x
-                                continue
                             x = np.asarray(sample[k])
                             if x.ndim == 7:  # (U, L, B, S, H, W, C) framestack
                                 x = merge_framestack(x)
@@ -569,6 +625,8 @@ def dreamer_family_loop(
 
     profiler.close()
     envs.close()
+    if getattr(rb, "spill", None) is not None:
+        rb.spill.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         # the deferred-sync player may be one window stale: sync once more

@@ -42,11 +42,13 @@ from sheeprl_tpu.algos.ppo.loss import entropy_loss, policy_loss, value_loss
 from sheeprl_tpu.algos.ppo.utils import (
     actions_for_env,
     normalize_obs_keys,
+    obs_to_np,
     prepare_obs,
     spaces_to_dims,
     test,
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_replay import stage_rollout, stage_scalar, steady_guard
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -279,10 +281,13 @@ def main(fabric: Any, cfg: Any) -> None:
         last_losses = jax.tree.map(lambda x: x[-1], losses)
         return p, o_state, last_losses
 
+    # donate the STAGED rollout and bootstrap obs too (argnums 2/3): the one
+    # dispatch consumes them exactly once, so XLA recycles their HBM for
+    # activations instead of holding a dead copy across the update
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1, 2, 3),
         static_argnames=("batch_size", "num_minibatches", "share_data", "n_shards"),
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
@@ -328,6 +333,10 @@ def main(fabric: Any, cfg: Any) -> None:
     base_lr = float(cfg.algo.optimizer.lr)
     clip_coef_v = initial_clip_coef
     ent_coef_v = initial_ent_coef
+    # arm jax.transfer_guard("disallow") around steady-state train dispatches
+    # (all staging above is explicit device_put, so the guard passing proves
+    # the zero-implicit-H2D contract end to end)
+    guard_on = bool(cfg.buffer.get("transfer_guard", False))
 
     rb = ReplayBuffer(
         rollout_steps,
@@ -405,36 +414,42 @@ def main(fabric: Any, cfg: Any) -> None:
 
         # ---------------- one-dispatch optimization -------------------------
         with timer("Time/train_time"):
+            # donated device staging: the rollout is normalized on HOST
+            # numpy, staged with EXPLICIT device_puts (transfer-guard-clean,
+            # data/device_replay.stage_rollout) and donated into the train
+            # phase, which consumes it exactly once per dispatch — its HBM is
+            # recycled for activations.  buffer.transfer_guard=true arms
+            # jax.transfer_guard("disallow") around the dispatch to prove no
+            # implicit H2D rides along.
             local = rb.buffer
-            rollout = {}
-            for k in obs_keys:
-                rollout[k] = _obs_to_device(local[k], k in cnn_keys)
-            rollout["actions"] = jnp.asarray(local["actions"])
-            rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
-            rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
-            rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            last_obs_dev = prepare_obs(obs, cnn_keys, mlp_keys)
-            if sharded_envs:
-                # multi-host, each process contributes its local env rows and
-                # the global batch is their concatenation
-                rollout = fabric.shard_batch(rollout, axis=1)
-                last_obs_dev = fabric.shard_batch(last_obs_dev, axis=0)
-            else:
-                rollout = fabric.replicate(rollout)
+            host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
+            host_rollout["actions"] = np.asarray(local["actions"])
+            host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
+            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+            # multi-host: each process contributes its local env rows and the
+            # global batch is their concatenation (axis=1); single-process
+            # replicates (env-axis minibatch gathers are cheapest replicated)
+            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
+            host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
+            last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
             key, tk = jax.random.split(key)
-            params, opt_state, last_losses = train_phase(
-                params,
-                opt_state,
-                rollout,
-                last_obs_dev,
-                tk,
-                jnp.float32(clip_coef_v),
-                jnp.float32(ent_coef_v),
-                batch_size=global_bs,
-                num_minibatches=num_minibatches,
-                share_data=share_data,
-                n_shards=n_shards,
-            )
+            clip_dev = stage_scalar(clip_coef_v)
+            ent_dev = stage_scalar(ent_coef_v)
+            with steady_guard(guard_on and update > start_iter):
+                params, opt_state, last_losses = train_phase(
+                    params,
+                    opt_state,
+                    rollout,
+                    last_obs_dev,
+                    tk,
+                    clip_dev,
+                    ent_dev,
+                    batch_size=global_bs,
+                    num_minibatches=num_minibatches,
+                    share_data=share_data,
+                    n_shards=n_shards,
+                )
             # refresh the host player once per iteration (one d2h transfer)
             player_params = fabric.to_host(params)
 

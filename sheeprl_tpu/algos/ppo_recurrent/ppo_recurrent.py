@@ -34,6 +34,7 @@ from sheeprl_tpu.algos.ppo_recurrent.agent import (
     one_hot_actions,
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_replay import stage_rollout, stage_scalar, steady_guard
 from sheeprl_tpu.utils.distribution import Categorical, Normal
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -222,13 +223,16 @@ def main(fabric: Any, cfg: Any) -> None:
         )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
+    # the staged rollout is donated too (argnum 2): one dispatch consumes it
+    # exactly once (see ppo.py)
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
-        donate_argnums=(0, 1),
+        donate_argnums=(0, 1, 2),
         static_argnames=("env_bs", "num_minibatches"),
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
+    guard_on = bool(cfg.buffer.get("transfer_guard", False))
 
     # ---------------- counters ----------------------------------------------
     rollout_steps = int(cfg.algo.rollout_steps)
@@ -353,18 +357,21 @@ def main(fabric: Any, cfg: Any) -> None:
                         aggregator.update("Game/ep_len_avg", ep_len)
 
         with timer("Time/train_time"):
+            # donated device staging: host-numpy layout + EXPLICIT device_puts
+            # (data/device_replay.stage_rollout), rollout donated into the
+            # one-dispatch update (see ppo.py)
             local = rb.buffer
-            rollout = {k: jnp.asarray(np.asarray(local[k], np.float32)) for k in mlp_keys}
-            rollout["actions"] = jnp.asarray(local["actions"])
-            rollout["prev_actions"] = jnp.asarray(local["prev_actions"])
-            rollout["logprobs"] = jnp.asarray(local["logprobs"][..., 0])
-            rollout["rewards"] = jnp.asarray(local["rewards"][..., 0])
-            rollout["dones"] = jnp.asarray(local["dones"][..., 0])
-            rollout["is_first"] = jnp.asarray(local["is_first"])  # (T, B, 1)
+            host_rollout = {k: np.asarray(local[k], np.float32) for k in mlp_keys}
+            host_rollout["actions"] = np.asarray(local["actions"])
+            host_rollout["prev_actions"] = np.asarray(local["prev_actions"])
+            host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
+            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+            host_rollout["is_first"] = np.asarray(local["is_first"])  # (T, B, 1)
             # single-process: replicate (the env-axis minibatch gathers are
             # cheapest on replicated data); multi-host: each process only has
             # its own env rows, so assemble the global env axis instead
-            rollout = fabric.shard_batch(rollout, axis=1) if sharded_envs else fabric.replicate(rollout)
+            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
 
             # bootstrap values for the state after the rollout
             dev_obs = {
@@ -377,14 +384,16 @@ def main(fabric: Any, cfg: Any) -> None:
                 is_first=jnp.asarray(is_first),
             )
             key, tk = jax.random.split(key)
-            carry_pair = (jnp.asarray(init_carry[0]), jnp.asarray(init_carry[1]))
-            last_v_flat = jnp.asarray(np.asarray(last_v)[..., 0])
-            params, opt_state, last_losses = train_phase(
-                params, opt_state, rollout,
-                fabric.shard_batch(carry_pair, axis=0) if sharded_envs else fabric.replicate(carry_pair),
-                fabric.shard_batch(last_v_flat, axis=0) if sharded_envs else fabric.replicate(last_v_flat),
-                tk, jnp.float32(ent_coef_v), env_bs=env_bs, num_minibatches=num_minibatches,
-            )
+            carry_pair = (np.asarray(init_carry[0]), np.asarray(init_carry[1]))
+            last_v_flat = np.asarray(last_v)[..., 0]
+            ent_dev = stage_scalar(ent_coef_v)
+            with steady_guard(guard_on and update > start_iter):
+                params, opt_state, last_losses = train_phase(
+                    params, opt_state, rollout,
+                    fabric.shard_batch(carry_pair, axis=0) if sharded_envs else fabric.replicate(carry_pair),
+                    fabric.shard_batch(last_v_flat, axis=0) if sharded_envs else fabric.replicate(last_v_flat),
+                    tk, ent_dev, env_bs=env_bs, num_minibatches=num_minibatches,
+                )
             player_params = fabric.to_host(params)
 
         if cfg.algo.anneal_lr:

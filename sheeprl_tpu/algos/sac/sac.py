@@ -33,6 +33,16 @@ from sheeprl_tpu.algos.sac.agent import build_agent, ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.sac.utils import prepare_obs, test
 from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_replay import (
+    DeviceReplay,
+    HostSpill,
+    estimate_step_bytes,
+    fit_hbm_window,
+    fused_uniform_train,
+    resolve_device_replay,
+    steady_guard,
+    update_chunks,
+)
 from sheeprl_tpu.parallel.compile import compile_once
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
@@ -248,16 +258,57 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     if state and "psync" in state:
         psync.load_state_dict(state["psync"])
 
-    rb = ReplayBuffer(
-        int(cfg.buffer.size) // num_envs,
-        num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
+    # ---------------- replay: device-resident HBM ring or host numpy --------
+    capacity = int(cfg.buffer.size) // num_envs
+    memmap_dir = os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None
+    use_device_replay = resolve_device_replay(cfg, fabric.accelerator)
+    if use_device_replay:
+        # rows: obs + next_obs (copies_per_key=2) + action/reward/flag tail
+        step_bytes = estimate_step_bytes(
+            obs_space, mlp_keys, extra_bytes=4 * (act_dim + 2), copies_per_key=2
+        )
+        hbm_window, spill_needed = fit_hbm_window(
+            capacity, num_envs, step_bytes, cfg.buffer.get("hbm_window")
+        )
+        spill = (
+            HostSpill(capacity, num_envs, memmap=cfg.buffer.memmap, memmap_dir=memmap_dir)
+            if spill_needed
+            else None
+        )
+        rb: Any = DeviceReplay(
+            hbm_window, num_envs, mesh=fabric.mesh, data_axis=fabric.data_axis, spill=spill
+        )
+    else:
+        rb = ReplayBuffer(capacity, num_envs, memmap=cfg.buffer.memmap, memmap_dir=memmap_dir)
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
     batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
+
+    # on-device sampling folded INTO the compiled update (zero H2D in steady
+    # state — data/device_replay.py): the fused program draws indices,
+    # gathers, and runs the scanned multi-update phase in one dispatch
+    train_phase_dev = None
+    if use_device_replay:
+        def _prep_batch(b):
+            return {
+                "obs": b["obs"],
+                "next_obs": b["next_obs"],
+                "actions": b["actions"],
+                "rewards": b["rewards"][..., 0],
+                "terminated": b["terminated"][..., 0],
+            }
+
+        train_phase_dev = fused_uniform_train(
+            fabric,
+            train_phase,
+            rb,
+            batch_size,
+            _prep_batch,
+            name=f"{cfg.algo.name}.train_phase_device",
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+    guard_on = bool(cfg.buffer.get("transfer_guard", False)) and use_device_replay
 
     # ---------------- main loop ---------------------------------------------
     # rank-offset: each process's envs must be distinct streams or
@@ -265,6 +316,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
+    counter_dev = None  # device-resident grad-step counter (zero-copy path)
+    train_windows = 0  # completed dispatched windows (guards arm past warmup)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
     player_key = jax.device_put(
@@ -334,7 +387,32 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             due = window.push(
                 ratio(policy_step / fabric.world_size), update, learning_starts, total_iters
             )
-            if due > 0:
+            if due > 0 and train_phase_dev is not None:
+                with timer("Time/train_time"):
+                    # zero-copy steady state: the batch never exists on the
+                    # host — sampling + gather are compiled into the update
+                    # dispatch, the step counter rides through the program as
+                    # device data, and (optionally) the transfer guard proves
+                    # no implicit H2D happens past the first (warmup) window
+                    if counter_dev is None:
+                        # replicated on the mesh, matching the program's output
+                        # placement — a single-device stage would cost one
+                        # extra (first-window) executable on multi-device
+                        counter_dev = fabric.replicate(np.int32(grad_step_counter))
+                    player_params = psync.before_dispatch(player_params)
+                    with steady_guard(guard_on and train_windows > 0):
+                        for u in update_chunks(
+                            due, bytes_per_update=rb.sampled_bytes_per_update(batch_size)
+                        ):
+                            key, tk = jax.random.split(key)
+                            params, opt_state, counter_dev, last_losses = train_phase_dev(
+                                params, opt_state, rb.buffers, rb.cursor, tk,
+                                counter_dev, n_samples=u,
+                            )
+                            grad_step_counter += u
+                    train_windows += 1
+                    player_params = psync.after_dispatch(params, player_params)
+            elif due > 0:
                 with timer("Time/train_time"):
                     sample = rb.sample(
                         batch_size, n_samples=due
@@ -401,6 +479,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
 
     profiler.close()
     envs.close()
+    if getattr(rb, "spill", None) is not None:
+        rb.spill.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         # the deferred-sync (decoupled) player may be stale: sync once more

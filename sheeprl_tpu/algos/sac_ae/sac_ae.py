@@ -29,7 +29,17 @@ from sheeprl_tpu.algos.sac.agent import ema_update, sample_action
 from sheeprl_tpu.algos.sac.loss import actor_loss, alpha_loss, critic_loss
 from sheeprl_tpu.algos.dreamer_v3.utils import normalize_obs_block
 from sheeprl_tpu.algos.sac_ae.agent import build_agent
-from sheeprl_tpu.data.buffers import ReplayBuffer, maybe_attach_mirror
+from sheeprl_tpu.data.buffers import ReplayBuffer
+from sheeprl_tpu.data.device_replay import (
+    DeviceReplay,
+    HostSpill,
+    estimate_step_bytes,
+    fit_hbm_window,
+    fused_uniform_train,
+    resolve_device_replay,
+    steady_guard,
+    update_chunks,
+)
 from sheeprl_tpu.parallel.fabric import PlayerSync
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
@@ -41,10 +51,7 @@ from sheeprl_tpu.utils.utils import (
     Ratio,
     TrainWindow,
     merge_framestack,
-    mirror_hbm_bytes_per_update,
-    probe_bytes_per_update,
     save_configs,
-    window_chunks,
     window_scan,
 )
 
@@ -318,37 +325,73 @@ def main(fabric: Any, cfg: Any) -> None:
     if state and "psync" in state:
         psync.load_state_dict(state["psync"])
 
-    rb = ReplayBuffer(
-        int(cfg.buffer.size) // num_envs,
-        num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-    )
-    # device-resident pixel mirror (data/buffers.py DeviceMirror): SAC-AE
-    # stores next_<k> rows, so both are mirrored; ~2x the ring bytes
-    mirror_pixel_keys = tuple(
-        src for k in cnn_keys for src in (k, f"next_{k}")
-    )
-    mirror_on = maybe_attach_mirror(
-        rb,
-        cfg,
-        fabric.accelerator,
-        obs_space,
-        cnn_keys,
-        mirror_keys=mirror_pixel_keys,
-        copies_per_key=2,
-    )
+    # device-resident replay (data/device_replay.py): the whole ring — pixel
+    # obs AND their stored next_<k> rows — lives in HBM sharded over the mesh
+    # `data` axis, sampling compiled into the update dispatch (supersedes the
+    # retired pixel-only DeviceMirror and the window_chunks byte probe)
+    capacity = int(cfg.buffer.size) // num_envs
+    memmap_dir = os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None
+    use_device_replay = resolve_device_replay(cfg, fabric.accelerator)
+    if use_device_replay:
+        # next_<k> copies double the obs bytes; actions/reward/flag row tail
+        step_bytes = estimate_step_bytes(
+            obs_space, obs_keys, extra_bytes=4 * (act_dim + 2), copies_per_key=2
+        )
+        hbm_window, spill_needed = fit_hbm_window(
+            capacity, num_envs, step_bytes, cfg.buffer.get("hbm_window")
+        )
+        spill = (
+            HostSpill(capacity, num_envs, memmap=cfg.buffer.memmap, memmap_dir=memmap_dir)
+            if spill_needed
+            else None
+        )
+        rb: Any = DeviceReplay(
+            hbm_window, num_envs, mesh=fabric.mesh, data_axis=fabric.data_axis, spill=spill
+        )
+    else:
+        rb = ReplayBuffer(capacity, num_envs, memmap=cfg.buffer.memmap, memmap_dir=memmap_dir)
     if state and cfg.buffer.checkpoint and "rb" in state:
         rb.load_state_dict(state["rb"])
 
     batch_size = int(cfg.algo.per_rank_batch_size) * fabric.local_world_size
 
+    train_phase_dev = None
+    if use_device_replay:
+        def _prep_batch(b):
+            out: Dict[str, jax.Array] = {
+                "actions": b["actions"],
+                "rewards": b["rewards"][..., 0],
+                "terminated": b["terminated"][..., 0],
+            }
+            for k in cnn_keys:
+                for src in (k, f"next_{k}"):
+                    x = b[src]
+                    if x.ndim >= 6:  # (U, B, S, H, W, C) framestack
+                        x = merge_framestack(x, jnp)
+                    out[src] = x  # uint8; /255 on device in the update body
+            for k in mlp_keys:
+                for src in (k, f"next_{k}"):
+                    x = b[src].astype(jnp.float32)
+                    out[src] = x.reshape(*x.shape[:2], -1)
+            return out
+
+        train_phase_dev = fused_uniform_train(
+            fabric,
+            train_phase,
+            rb,
+            batch_size,
+            _prep_batch,
+            name=f"{cfg.algo.name}.train_phase_device",
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+    guard_on = bool(cfg.buffer.get("transfer_guard", False)) and use_device_replay
+
     # rank-offset: each process's envs must be distinct streams or
     # multi-host DP collects the same data num_processes times
     obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
-    bytes_per_update = None  # probed at the first train window (window_chunks)
-    mirror_hbm_bytes = 0.0  # on-device gathered pixel bytes/update (mirror)
+    counter_dev = None  # device-resident grad-step counter (zero-copy path)
+    train_windows = 0  # completed dispatched windows (guards arm past warmup)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
     player_key = jax.device_put(
@@ -401,60 +444,51 @@ def main(fabric: Any, cfg: Any) -> None:
             per_rank_gradient_steps = window.push(
                 ratio(policy_step / fabric.world_size), update, learning_starts, total_iters
             )
-            if per_rank_gradient_steps > 0:
+            if per_rank_gradient_steps > 0 and train_phase_dev is not None:
                 with timer("Time/train_time"):
-                    # burst windows are split under a device byte budget
-                    # (utils.window_chunks) — pixel next_obs pairs double the
-                    # shipped bytes, so the first repaid window can otherwise
-                    # exceed HBM
-                    sample_keys = None
-                    if mirror_on:
-                        sample_keys = tuple(
-                            src
-                            for k in mlp_keys
-                            for src in (k, f"next_{k}")
-                        ) + ("actions", "rewards", "terminated")
-                    if bytes_per_update is None:
-                        # probe only the keys that ship over H2D (mirror
-                        # pixels are gathered on device — see the dreamer
-                        # loop's note); the gathered block is budgeted
-                        # against HBM separately by window_chunks
-                        bytes_per_update = probe_bytes_per_update(
-                            rb, batch_size, keys=sample_keys
-                        )
-                        if mirror_on:
-                            # rows=2: obs + next_obs rows both gather
-                            mirror_hbm_bytes = mirror_hbm_bytes_per_update(
-                                obs_space, cnn_keys, batch_size, rows=2
-                            )
-                    # one player sync per ratio window, not per chunk (a
-                    # per-chunk refresh pulls full player params D2H each
-                    # time — see the dreamer loop's note)
+                    # zero-copy steady state: sampling + gather compiled into
+                    # the update dispatch, counter rides as device data, the
+                    # transfer guard (optional) proves no implicit H2D past
+                    # the first window; power-of-two chunks reuse executables
+                    if counter_dev is None:
+                        # replicated on the mesh, matching the program's output
+                        # placement — a single-device stage would cost one
+                        # extra (first-window) executable on multi-device
+                        counter_dev = fabric.replicate(np.int32(grad_step_counter))
                     player_params = psync.before_dispatch(player_params)
-                    for u in window_chunks(
-                        per_rank_gradient_steps,
-                        bytes_per_update,
-                        hbm_bytes_per_update=mirror_hbm_bytes,
-                    ):
-                        sample = rb.sample(batch_size, n_samples=u, keys=sample_keys)
+                    with steady_guard(guard_on and train_windows > 0):
+                        for u in update_chunks(
+                            per_rank_gradient_steps,
+                            bytes_per_update=rb.sampled_bytes_per_update(batch_size),
+                        ):
+                            key, tk = jax.random.split(key)
+                            params, opt_state, counter_dev, last_losses = train_phase_dev(
+                                params, opt_state, rb.buffers, rb.cursor, tk,
+                                counter_dev, n_samples=u,
+                            )
+                            grad_step_counter += u
+                    train_windows += 1
+                    player_params = psync.after_dispatch(params, player_params)
+            elif per_rank_gradient_steps > 0:
+                with timer("Time/train_time"):
+                    # host-numpy fallback: burst windows chunked into powers
+                    # of two for compile reuse; one player sync per ratio
+                    # window, not per chunk (a per-chunk refresh pulls full
+                    # player params D2H each time — see the dreamer loop)
+                    player_params = psync.before_dispatch(player_params)
+                    for u in update_chunks(per_rank_gradient_steps):
+                        sample = rb.sample(batch_size, n_samples=u)
                         batches: Dict[str, jax.Array] = {
                             "actions": jnp.asarray(sample["actions"]),
                             "rewards": jnp.asarray(sample["rewards"][..., 0]),
                             "terminated": jnp.asarray(sample["terminated"][..., 0]),
                         }
-                        for src in mirror_pixel_keys if mirror_on else ():
-                            t_idx, e_idx = rb.last_sample_indices
-                            x = rb.mirror.gather(src, t_idx, e_idx)
-                            if x.ndim >= 6:  # (U, B[, N], S, H, W, C) framestack
-                                x = merge_framestack(x, jnp)
-                            batches[src] = x
-                        for k in cnn_keys if not mirror_on else ():
+                        for k in cnn_keys:
                             for src in (k, f"next_{k}"):
                                 x = np.asarray(sample[src])
                                 # framestacked sample is (U, B, S, H, W, C) =
-                                # 6-dim — the old `== 7` guard could never
-                                # fire, shipping unmerged stacks into the
-                                # encoder; match the mirror path above
+                                # 6-dim — merge stacks into channels before
+                                # the encoder
                                 if x.ndim >= 6:
                                     x = merge_framestack(x)
                                 batches[src] = jnp.asarray(x)  # uint8; /255 on device
@@ -508,6 +542,8 @@ def main(fabric: Any, cfg: Any) -> None:
             break
 
     envs.close()
+    if getattr(rb, "spill", None) is not None:
+        rb.spill.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         from sheeprl_tpu.algos.sac_ae.utils import test

@@ -1,1 +1,23 @@
-"""sheeprl_tpu.data."""
+"""sheeprl_tpu.data: replay buffers.
+
+``buffers`` holds the host-numpy suite (``ReplayBuffer``,
+``SequentialReplayBuffer``, ``EnvIndependentReplayBuffer``,
+``EpisodeBuffer``) plus the deprecated ``DeviceMirror`` shim;
+``device_replay`` is the zero-copy device-resident path — the
+mesh-sharded HBM ring with on-device sampling compiled into the update
+dispatch (docs/device_replay.md) that the algo loops use on accelerators.
+"""
+
+from sheeprl_tpu.data.buffers import (  # noqa: F401
+    EnvIndependentReplayBuffer,
+    EpisodeBuffer,
+    ReplayBuffer,
+    SequentialReplayBuffer,
+)
+from sheeprl_tpu.data.device_replay import (  # noqa: F401
+    DeviceReplay,
+    HostSpill,
+    resolve_device_replay,
+    steady_guard,
+    update_chunks,
+)

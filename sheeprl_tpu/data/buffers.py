@@ -56,85 +56,44 @@ def to_device(batch: Arrays, dtype: Optional[Any] = None, device: Optional[Any] 
 
 
 class DeviceMirror:
-    """Device-resident ring mirror of selected (pixel) keys.
+    """DEPRECATED shim over :class:`sheeprl_tpu.data.device_replay.DeviceReplay`.
 
-    TPU-native replay: the host ring stays the source of truth (sampling
-    law, checkpointing, episode bookkeeping), but sampled PIXEL blocks are
-    gathered ON DEVICE from a mirrored uint8 ring instead of shipping a
-    ``(U, L, B, H, W, C)`` block per update window.  Ratio-governed replay
-    oversamples every stored frame by ``updates x B x L / stored_steps``
-    (~500x at the DV3-S DMC recipe), so mirroring turns H2D traffic from
-    O(updates x batch x seq) into O(env steps) — 12.6 MB -> 12.3 KB per
-    update at DV3-S shapes.  The reference gets the same effect by keeping
-    its torch buffers on the GPU (sheeprl/data/buffers.py ``device=``);
-    this is that capability rebuilt for JAX: jitted donated scatter writes,
-    jitted fancy-index gathers, ring positions computed on host so the
-    mirror layout is bit-identical to the host ring's.
-
-    Multi-chip plan: under a data-parallel mesh each process mirrors only
-    its OWN env streams (per-rank buffers already split that way), so the
-    ring shards naturally across hosts; within one host's chips the gather
-    output is re-laid by ``fabric.shard_batch`` (a no-op on one device).
-    Sharding the ring itself over the mesh ``data`` axis — so each chip
-    holds 1/N of the slots and gathers ride ICI — is the v2 design for
-    single-host multi-chip; the host path stays the fallback everywhere.
+    The per-device, probe-gated pixel mirror has been superseded by the
+    mesh-sharded device-resident replay (``data/device_replay.py``), which
+    keeps EVERY key in HBM and samples inside the compiled update step —
+    no host ring, no host-drawn coordinates, no per-key mirror budget.  The
+    algo loops no longer construct mirrors; this class remains so external
+    callers of ``attach_mirror`` keep working (identical scatter/gather
+    semantics, now riding ``DeviceReplay``'s ring primitives) while they
+    migrate — see docs/device_replay.md for the migration notes.
     """
 
     def __init__(self, capacity: int, n_envs: int):
-        self._capacity = int(capacity)
-        self._n_envs = int(n_envs)
-        self._arrays: Dict[str, Any] = {}
-        self._scatter = None
-        self._gather = None
+        import warnings
 
-    def _ops(self):
-        if self._scatter is None:
-            import jax
+        warnings.warn(
+            "DeviceMirror/attach_mirror is deprecated: use buffer.device=True "
+            "(data/device_replay.DeviceReplay) — the mirror shim keeps the old "
+            "write/gather contract over the new ring (docs/device_replay.md)",
+            DeprecationWarning,
+            stacklevel=3,
+        )
+        from sheeprl_tpu.data.device_replay import DeviceReplay
 
-            # donate the ring so updates are in-place (no 2x HBM spike)
-            self._scatter = jax.jit(
-                lambda arr, rows, t, e: arr.at[t, e[None, :]].set(rows),
-                donate_argnums=0,
-            )
-            self._gather = jax.jit(lambda arr, t, e: arr[t, e])
-        return self._scatter, self._gather
-
-    def _ensure(self, key: str, shape: Tuple[int, ...], dtype: Any) -> None:
-        if key not in self._arrays:
-            import jax.numpy as jnp
-
-            self._arrays[key] = jnp.zeros(
-                (self._capacity, self._n_envs) + tuple(shape), dtype
-            )
+        self._replay = DeviceReplay(capacity, n_envs)
 
     def write(self, key: str, rows: np.ndarray, time_pos: np.ndarray, env_cols: Sequence[int]) -> None:
         """Scatter ``rows (T, K, *)`` at ring slots ``time_pos (T, K)`` for
         env columns ``env_cols (K,)`` — the exact slots the host ring wrote."""
-        import jax.numpy as jnp
-
-        self._ensure(key, rows.shape[2:], rows.dtype)
-        scatter, _ = self._ops()
-        self._arrays[key] = scatter(
-            self._arrays[key],
-            jnp.asarray(rows),
-            jnp.asarray(np.asarray(time_pos), jnp.int32),
-            jnp.asarray(np.asarray(env_cols), jnp.int32),
-        )
+        self._replay.write_at(key, np.asarray(rows), np.asarray(time_pos), env_cols)
 
     def gather(self, key: str, time_idx: np.ndarray, env_idx: np.ndarray):
         """Device gather of ``(U, L, B, *)`` sequences at host-sampled ring
         indices; the result never crosses the host<->device link."""
-        import jax.numpy as jnp
-
-        _, gather = self._ops()
-        return gather(
-            self._arrays[key],
-            jnp.asarray(np.asarray(time_idx), jnp.int32),
-            jnp.asarray(np.asarray(env_idx), jnp.int32),
-        )
+        return self._replay.gather_at(key, np.asarray(time_idx), np.asarray(env_idx))
 
     def nbytes(self) -> int:
-        return sum(int(np.prod(a.shape)) * a.dtype.itemsize for a in self._arrays.values())
+        return self._replay.hbm_bytes
 
 
 def maybe_attach_mirror(
@@ -146,10 +105,13 @@ def maybe_attach_mirror(
     mirror_keys: Optional[Sequence[str]] = None,
     copies_per_key: int = 1,
 ) -> bool:
-    """One policy for every algo's ``buffer.device_mirror`` handling:
-    resolve ``auto`` (on iff training on an accelerator), estimate the ring
-    bytes from the observation space (× ``copies_per_key`` for layouts that
-    also store ``next_<k>`` rows), enforce ``SHEEPRL_MIRROR_BUDGET_BYTES``
+    """DEPRECATED (kept for external callers): the algo loops now route
+    through ``data/device_replay.DeviceReplay`` (``buffer.device``), which
+    holds the WHOLE ring in HBM and samples on device — the mirror's
+    probe-gated pixel-only subset is subsumed.  Original contract: resolve
+    ``auto`` (on iff training on an accelerator), estimate the ring bytes
+    from the observation space (× ``copies_per_key`` for layouts that also
+    store ``next_<k>`` rows), enforce ``SHEEPRL_MIRROR_BUDGET_BYTES``
     (default 6 GiB) with a printed graceful fallback, and attach.
     Returns whether the mirror is active."""
     mirror_cfg = cfg.buffer.get("device_mirror", "auto")

@@ -56,9 +56,41 @@ __all__ = [
     "rules_for_algo",
     "size_threshold_rules",
     "spec_from_config",
+    "replay_partition_spec",
+    "replay_sharding",
     "DREAMER_V3_RULES",
     "RULE_TABLES",
 ]
+
+
+# --------------------------------------------------------------------------
+# replay shardings (data/device_replay.py)
+# --------------------------------------------------------------------------
+
+def replay_partition_spec(n_envs: int, mesh: Optional[Mesh], data_axis: str = "data") -> P:
+    """``PartitionSpec`` for a device-resident replay ring ``(T, n_envs, *)``.
+
+    The ENV axis (axis 1) shards over the mesh ``data`` axis — each device
+    owns the ring slots of its own env streams, the same ``data``-axis
+    layout ``fabric.shard_batch`` gives a shipped batch, so on-device
+    sampled gathers stay mostly shard-local and the consuming train step
+    sees the canonical data-parallel placement.  The time axis never shards
+    (ring writes hit every shard's head in lockstep).  When the env count
+    does not divide the ``data`` axis the ring replicates — a correct (if
+    un-sharded) placement, mirroring ``shard_batch``'s divisibility rule.
+    Trailing feature dims are left unspecified (replicated) by the short
+    spec, whatever the leaf rank."""
+    if mesh is None or data_axis not in mesh.shape:
+        return P()
+    n_data = int(mesh.shape[data_axis])
+    if n_data <= 1 or int(n_envs) % n_data != 0:
+        return P()
+    return P(None, data_axis)
+
+
+def replay_sharding(mesh: Mesh, n_envs: int, data_axis: str = "data") -> NamedSharding:
+    """``NamedSharding`` form of :func:`replay_partition_spec` on ``mesh``."""
+    return NamedSharding(mesh, replay_partition_spec(n_envs, mesh, data_axis))
 
 
 # --------------------------------------------------------------------------

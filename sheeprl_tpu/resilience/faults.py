@@ -51,13 +51,18 @@ KNOWN_SITES = (
     "checkpoint.commit",
     "serve.http",
     "fabric.copy_to",
+    "replay.spill",
 )
 
 KINDS = ("raise", "hang", "latency", "corrupt", "truncate")
 
 #: Sites whose hook passes a byte payload (``fault_bytes``) — the only
-#: legal targets for ``corrupt``/``truncate`` specs.
+#: legal targets for ``corrupt`` specs.
 BYTE_SITES = ("checkpoint.write_shard",)
+
+#: Sites whose hook passes replay rows (``fault_rows``): ``truncate`` there
+#: tail-halves the queued rows (a torn spill write), not a byte payload.
+ROW_SITES = ("replay.spill",)
 
 ENV_VAR = "SHEEPRL_FAULT_PLAN"
 
@@ -103,13 +108,19 @@ class FaultSpec:
             )
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind '{self.kind}' (known: {', '.join(KINDS)})")
-        if self.kind in ("corrupt", "truncate") and self.site not in BYTE_SITES:
+        payload_sites = BYTE_SITES + ROW_SITES
+        if self.kind == "corrupt" and self.site not in BYTE_SITES:
             # a byte fault at a value site would validate and then silently
             # never act — exactly the "drill runs green while injecting
             # nothing" failure the build-time checks exist to prevent
             raise ValueError(
                 f"fault kind '{self.kind}' only acts at byte-payload sites "
                 f"({', '.join(BYTE_SITES)}), not '{self.site}'"
+            )
+        if self.kind == "truncate" and self.site not in payload_sites:
+            raise ValueError(
+                f"fault kind 'truncate' only acts at payload sites "
+                f"({', '.join(payload_sites)}), not '{self.site}'"
             )
         if self.at is None and self.every is None and self.p is None:
             raise ValueError(
@@ -310,6 +321,24 @@ def fault_bytes(site: str, payload: bytes) -> bytes:
             flip = max(1, len(payload) // 2)
             payload = payload[:flip] + bytes(b ^ 0xFF for b in payload[flip : flip + 8]) + payload[flip + 8 :]
     return payload
+
+
+def fault_rows(site: str, rows: "dict") -> "dict":
+    """Pass a dict of ``(T, B, *)`` replay rows through the plan's specs for
+    ``site`` (the ``replay.spill`` hook): latency/hang sleep, raise raises,
+    truncate drops the tail half of the time axis (a torn spill write —
+    the spill worker persists fewer rows than the device ring took)."""
+    if _PLAN is None:
+        return rows
+    for spec in _PLAN.poll(site):
+        _record_injection(site, spec.kind)
+        if spec.kind in ("hang", "latency"):
+            time.sleep(float(spec.seconds))
+        elif spec.kind == "raise":
+            raise spec.make_exception()
+        elif spec.kind == "truncate":
+            rows = {k: v[: max(1, v.shape[0] // 2)] for k, v in rows.items()}
+    return rows
 
 
 def _record_injection(site: str, kind: str) -> None:

@@ -260,8 +260,14 @@ def window_chunks(
     budget_bytes: Optional[float] = None,
     hbm_bytes_per_update: float = 0.0,
 ):
-    """Split an update window into dispatch chunk sizes whose shipped
-    ``(U, ...)`` batch block stays under a device byte budget.
+    """DEPRECATED: the algo loops now chunk purely for compile reuse via
+    ``data/device_replay.update_chunks`` — with the replay ring
+    device-resident (``buffer.device``) there is no shipped H2D block to
+    byte-budget.  Kept (with ``probe_bytes_per_update`` /
+    ``mirror_hbm_bytes_per_update``) for external callers on the host path.
+
+    Original contract: split an update window into dispatch chunk sizes
+    whose shipped ``(U, ...)`` batch block stays under a device byte budget.
 
     The first window after ``learning_starts`` is a burst: the ratio
     governor repays every pre-training env step at once, so e.g.

@@ -12,24 +12,24 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/8: unit + E2E dry-run suite (budget 1500s) ==="
+echo "=== stage 1/9: unit + E2E dry-run suite (budget 1500s) ==="
 timeout -k 15 1500 python -m pytest tests/ -x -q \
   --ignore=tests/test_regression --ignore=tests/test_checkpoint \
   --ignore=tests/test_resilience
 
-echo "=== stage 2/8: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) (budget 420s) ==="
+echo "=== stage 2/9: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) (budget 420s) ==="
 timeout -k 15 420 python -m pytest tests/test_checkpoint -q
 
-echo "=== stage 3/8: chaos drills (fault injection: env storm, SIGKILL+quarantine resume, serve under faults) (budget 600s) ==="
+echo "=== stage 3/9: chaos drills (fault injection: env storm, SIGKILL+quarantine resume, serve under faults) (budget 600s) ==="
 timeout -k 15 600 python -m pytest tests/test_resilience -q
 
-echo "=== stage 4/8: numeric regression (goldens + reference fixture) (budget 600s) ==="
+echo "=== stage 4/9: numeric regression (goldens + reference fixture) (budget 600s) ==="
 timeout -k 15 600 python -m pytest tests/test_regression -q
 
-echo "=== stage 5/8: multichip dryrun (virtual 8-device mesh) (budget 900s) ==="
+echo "=== stage 5/9: multichip dryrun (virtual 8-device mesh) (budget 900s) ==="
 timeout -k 15 900 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "=== stage 6/8: 2-D (data x model) mesh training cell + compile budget (budget 600s) ==="
+echo "=== stage 6/9: 2-D (data x model) mesh training cell + compile budget (budget 600s) ==="
 # dreamer_v3 end-to-end through the CLI on a 2x4 fake-device mesh: the
 # partition-rules (TP) path with the recompile detector as a hard gate —
 # algo.max_recompiles=1 means each compile-once program (train phase, player
@@ -55,13 +55,53 @@ run([
     "checkpoint.every=0", "checkpoint.save_last=False", "buffer.memmap=False",
     "metric.log_level=0", "log_dir=/tmp/run_ci_tp_logs", "print_config=False",
 ])
-print("stage 6/8 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
+print("stage 6/9 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
 PY
 
-echo "=== stage 7/8: policy-serving smoke (HTTP server + batched requests + clean shutdown) (budget 600s) ==="
+echo "=== stage 7/9: policy-serving smoke (HTTP server + batched requests + clean shutdown) (budget 600s) ==="
 timeout -k 15 600 python tests/serve_smoke.py
 
-echo "=== stage 8/8: fault-injection zero-overhead gate (empty plan steady-state within 2%) (budget 600s) ==="
+echo "=== stage 8/9: fault-injection zero-overhead gate (empty plan steady-state within 2%) (budget 600s) ==="
 timeout -k 15 600 env BENCH_TARGET=fault_overhead python bench.py
+
+echo "=== stage 9/9: zero-copy device replay (dreamer_v3 + sac, transfer guard armed) (budget 900s) ==="
+# Coupled dreamer_v3 and sac train SHORT real runs (not dryruns: the guard
+# only means something once steady-state windows exist) with the
+# device-resident replay forced on, jax.transfer_guard("disallow") armed
+# around every post-warmup train window (buffer.transfer_guard=true), and
+# the recompile budget at 1 — a steady state that ships a batch H2D, or a
+# cursor that churns the executable signature, dies here red.
+timeout -k 15 900 python - <<'PY'
+from sheeprl_tpu.cli import run
+common = [
+    "env=dummy", "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+    "fabric.devices=2", "fabric.accelerator=cpu",
+    "buffer.memmap=False", "buffer.size=1024", "buffer.device=True",
+    "buffer.transfer_guard=True", "checkpoint.every=0", "checkpoint.save_last=False",
+    "metric.log_level=0", "algo.max_recompiles=1", "algo.run_test=False",
+    "print_config=False",
+]
+run([
+    "exp=dreamer_v3", "env.id=discrete_dummy", "env.action_repeat=1",
+    "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[]",
+    "algo.horizon=4", "algo.dense_units=16", "algo.mlp_layers=1",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.world_model.recurrent_model.recurrent_state_size=32",
+    "algo.world_model.transition_model.hidden_size=32",
+    "algo.world_model.representation_model.hidden_size=32",
+    "algo.world_model.discrete_size=4", "algo.world_model.stochastic_size=4",
+    "algo.per_rank_batch_size=4", "algo.per_rank_sequence_length=8",
+    "algo.learning_starts=16", "algo.total_steps=64", "algo.replay_ratio=0.5",
+    "log_dir=/tmp/run_ci_replay_dv3",
+] + common)
+print("stage 9 dv3 OK: zero-copy steady state under transfer guard")
+run([
+    "exp=sac", "env.id=continuous_dummy",
+    "algo.learning_starts=16", "algo.total_steps=96", "algo.replay_ratio=0.5",
+    "algo.per_rank_batch_size=8",
+    "log_dir=/tmp/run_ci_replay_sac",
+] + common)
+print("stage 9/9 OK: dreamer_v3 + sac trained zero-copy under the transfer guard")
+PY
 
 echo "CI gate: ALL GREEN"
