@@ -624,6 +624,116 @@ def bench_serve() -> dict:
     }
 
 
+def bench_env() -> dict:
+    """Env-stepping throughput axis (``--mode env`` / ``BENCH_TARGET=env``,
+    ISSUE 11): env-steps/s for the three rollout dataflows on CartPole-class
+    dynamics —
+
+    * ``cpu_gym_async`` — gymnasium ``AsyncVectorEnv`` over CPU gym
+      processes (the historical path; the BENCH_TPU.md honest negative);
+    * ``jax_adapter`` — the same pure-JAX env stepped one jitted program
+      per step through ``JaxToGymAdapter`` + ``SyncVectorEnv`` (the
+      compatibility path every algo can use);
+    * ``jax_fused`` — the Anakin dataflow: ONE jitted ``lax.scan`` over the
+      batched in-trace env step (``VectorJaxEnv``), thousands of instances
+      per dispatch, zero host round-trips.
+
+    Actions are pre-sampled/constant so the axis isolates env dataflow from
+    policy math.  The fused number uses many more instances on purpose —
+    batch scale IS the Anakin win; per-path env counts are reported.
+    """
+    import numpy as np
+
+    import gymnasium as gym
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.envs.jax.adapter import JaxToGymAdapter
+    from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+    from sheeprl_tpu.envs.jax.registry import make_jax_env
+
+    n_async = int(os.environ.get("BENCH_ENVS", 16))
+    n_fused = int(os.environ.get("BENCH_FUSED_ENVS", 1024))
+    steps = int(os.environ.get("BENCH_ENV_STEPS", 512))
+    fused_iters = int(os.environ.get("BENCH_ENV_ITERS", 8))
+
+    rng = np.random.default_rng(0)
+    actions = rng.integers(0, 2, (steps, n_async)).astype(np.int64)
+
+    # ---- cpu gym async (the AsyncVectorEnv baseline) ----------------------
+    venv = gym.vector.AsyncVectorEnv(
+        [lambda: gym.make("CartPole-v1") for _ in range(n_async)]
+    )
+    venv.reset(seed=0)
+    # one warm step outside the timer (worker spin-up)
+    venv.step(actions[0])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        venv.step(actions[i])
+    cpu_gym_rate = steps * n_async / (time.perf_counter() - t0)
+    venv.close()
+
+    # ---- jax adapter through SyncVectorEnv (its shipped path) -------------
+    senv = gym.vector.SyncVectorEnv(
+        [lambda: JaxToGymAdapter(make_jax_env("cartpole")) for _ in range(n_async)]
+    )
+    senv.reset(seed=0)
+    senv.step(actions[0])
+    t0 = time.perf_counter()
+    for i in range(steps):
+        senv.step(actions[i])
+    adapter_rate = steps * n_async / (time.perf_counter() - t0)
+    senv.close()
+
+    # ---- Anakin fused scan -------------------------------------------------
+    fused_env = VectorJaxEnv(make_jax_env("cartpole"), n_fused)
+
+    def fused_rollout(state, key):
+        def body(carry, k):
+            state = carry
+            acts = jax.random.bernoulli(k, shape=(n_fused,)).astype(jnp.int32)
+            state, _, reward, term, trunc, _ = fused_env.step(state, acts)
+            return state, reward
+
+        state, rewards = jax.lax.scan(body, state, jax.random.split(key, steps))
+        return state, jnp.sum(rewards)
+
+    fused_rollout = jax.jit(fused_rollout, donate_argnums=(0,))
+    state, _ = fused_env.reset(jax.random.PRNGKey(0))
+    t_first = time.perf_counter()
+    state, s = fused_rollout(state, jax.random.PRNGKey(1))
+    s.block_until_ready()
+    first_call_s = time.perf_counter() - t_first
+    keys = list(jax.random.split(jax.random.PRNGKey(2), fused_iters))
+    t0 = time.perf_counter()
+    with jax.transfer_guard_host_to_device("disallow"):
+        for i in range(fused_iters):
+            state, s = fused_rollout(state, keys[i])
+    s.block_until_ready()
+    fused_rate = steps * n_fused * fused_iters / (time.perf_counter() - t0)
+
+    dev = jax.devices()[0]
+    return {
+        "metric": (
+            f"env_steps_per_s (cartpole: cpu-gym async x{n_async} vs jax adapter "
+            f"x{n_async} vs jax fused x{n_fused}, {dev.platform})"
+        ),
+        "value": round(fused_rate, 1),
+        "unit": "env_steps/s",
+        # the acceptance comparison: fused Anakin rollout vs the
+        # AsyncVectorEnv cpu-gym baseline on this host
+        "vs_baseline": round(fused_rate / cpu_gym_rate, 2),
+        "env_steps_per_s_cpu_gym_async": round(cpu_gym_rate, 1),
+        "env_steps_per_s_jax_adapter": round(adapter_rate, 1),
+        "env_steps_per_s_jax_fused": round(fused_rate, 1),
+        "n_envs_async": n_async,
+        "n_envs_fused": n_fused,
+        "first_call_s": round(first_call_s, 3),
+        # guard completion == zero H2D inside the fused steady loop
+        "h2d_bytes_per_update": 0.0,
+    }
+
+
 def bench_fault_overhead() -> dict:
     """Zero-overhead gate for the fault-injection layer (docs/resilience.md).
 
@@ -756,6 +866,8 @@ def _run_bench() -> dict:
         return bench_device_replay()
     if target == "fault_overhead":
         return bench_fault_overhead()
+    if target == "env":
+        return bench_env()
     if target in BASELINE_CPU_WALL_CLOCK_S:
         return bench_cpu_wall_clock(target)
     return bench_dreamer_v3()

@@ -104,7 +104,7 @@ STAGES = [
             "algo.run_test=False",
             "env.num_envs=1",
             "buffer.size=25000",
-            "buffer.device_mirror=True",
+            "buffer.device=True",
             "buffer.memmap=False",
             "metric.log_level=1",
             "metric/logger=csv",

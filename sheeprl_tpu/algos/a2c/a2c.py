@@ -35,6 +35,7 @@ from sheeprl_tpu.algos.ppo.utils import (
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_replay import stage_rollout, steady_guard
+from sheeprl_tpu.envs.jax.registry import anakin_enabled
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -56,15 +57,27 @@ def main(fabric: Any, cfg: Any) -> None:
         save_configs(cfg, log_dir)
 
     num_envs = cfg.env.num_envs
-    envs = vectorize(
-        cfg,
-        [
-            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-    )
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
+    use_anakin = anakin_enabled(cfg, fabric)
+    if use_anakin:
+        # Anakin mode (envs/jax/anakin.py): the env lives INSIDE the
+        # compiled update — no vector-env processes exist at all
+        from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+        from sheeprl_tpu.envs.jax.registry import jax_env_from_cfg
+
+        envs = None
+        venv = VectorJaxEnv(jax_env_from_cfg(cfg), num_envs)
+        obs_space = venv.single_observation_space
+        act_space = venv.single_action_space
+    else:
+        envs = vectorize(
+            cfg,
+            [
+                make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+                for i in range(num_envs)
+            ],
+        )
+        obs_space = envs.single_observation_space
+        act_space = envs.single_action_space
     normalize_obs_keys(cfg, obs_space)
     actions_dim, is_continuous = spaces_to_dims(act_space)
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
@@ -151,6 +164,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # rollout/last-obs staging is donated too (argnums 2/3): one dispatch
     # consumes the staged block exactly once (see ppo.py)
+    train_phase_fn = train_phase  # raw callable: the Anakin path fuses it
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
@@ -177,18 +191,66 @@ def main(fabric: Any, cfg: Any) -> None:
     last_checkpoint = int(state.get("last_checkpoint", 0))
     base_lr = float(cfg.algo.optimizer.lr)
 
-    rb = ReplayBuffer(
-        rollout_steps,
-        num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-        obs_keys=obs_keys,
-    )
+    # ---------------- Anakin fused rollout+train ----------------------------
+    if use_anakin:
+        from sheeprl_tpu.envs.jax.anakin import (
+            init_actor_state,
+            make_rollout_fn,
+            traced_polynomial_decay,
+        )
+
+        def _sample(out, k):
+            return sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
+
+        rollout_fn = make_rollout_fn(
+            venv,
+            agent.apply,
+            _sample,
+            cnn_keys=cnn_keys,
+            mlp_keys=mlp_keys,
+            action_space=act_space,
+            gamma=gamma,
+            rollout_steps=rollout_steps,
+            store_logprobs=False,  # A2C re-evaluates actions under current params
+        )
+
+        def anakin_phase(p, o_state, actor, k):
+            """``lax.scan`` env rollout + GAE + the full-batch gradient step
+            in ONE device program (lr annealing in-trace — see ppo.py)."""
+            k_roll, k_next = jax.random.split(k)
+            if cfg.algo.anneal_lr:
+                o_state = set_learning_rate(
+                    o_state,
+                    traced_polynomial_decay(actor["update"], initial=base_lr, max_decay_steps=total_iters),
+                )
+            actor, rollout, last_obs, stats = rollout_fn(p, actor, k_roll)
+            p, o_state, losses = train_phase_fn(p, o_state, rollout, last_obs)
+            return p, o_state, actor, k_next, losses, stats
+
+        anakin_step = fabric.compile(
+            anakin_phase,
+            name=f"{cfg.algo.name}.anakin_phase",
+            donate_argnums=(0, 1, 2),
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+        actor_state = init_actor_state(
+            fabric, venv, jax.random.fold_in(key, fabric.global_rank + 1), start_iter - 1, sharded_envs
+        )
+        rb = None
+    else:
+        rb = ReplayBuffer(
+            rollout_steps,
+            num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+            obs_keys=obs_keys,
+        )
 
     step_data: Dict[str, np.ndarray] = {}
     # rank-offset: each process's envs must be distinct streams or
     # multi-host DP collects the same data num_processes times
-    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
+    if envs is not None:
+        obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
     # per-rank player key stream, advanced inside policy_step_fn; the main
     # `key` stays rank-identical for train dispatches
@@ -200,60 +262,77 @@ def main(fabric: Any, cfg: Any) -> None:
     )
 
     for update in range(start_iter, total_iters + 1):
-        with timer("Time/env_interaction_time"):
-            with jax.default_device(host):
-                for _ in range(rollout_steps):
-                    policy_step += num_envs * fabric.num_processes
-                    dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-                    actions, logprobs, _, player_key = policy_step_fn(
-                        player_params, dev_obs, player_key
+        if use_anakin:
+            # -------- fused rollout+train: ONE dispatch per update ---------
+            with timer("Time/train_time"):
+                with steady_guard(guard_on and update > start_iter):
+                    params, opt_state, actor_state, key, last_losses, ep_stats = anakin_step(
+                        params, opt_state, actor_state, key
                     )
-                    actions_np = np.asarray(actions)
-                    next_obs, rewards, terminated, truncated, info = envs.step(
-                        actions_for_env(actions_np, act_space)
-                    )
-                    dones = np.logical_or(terminated, truncated)
-                    rewards = np.asarray(rewards, np.float32)
-                    if np.any(truncated):
-                        final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
-                        if final_obs is not None:
-                            padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-                            for k in obs_keys:
-                                padded[k][truncated] = final_obs[k]
-                            vals = np.asarray(
-                                values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys))
-                            )
-                            rewards[truncated] += gamma * vals[truncated]
+                policy_step += num_envs * rollout_steps * fabric.num_processes
+            if cfg.metric.log_level > 0:
+                from sheeprl_tpu.envs.jax.anakin import episode_stats_from_device
 
-                    for k in obs_keys:
-                        step_data[k] = np.asarray(obs[k])[None]
-                    step_data["actions"] = actions_np[None]
-                    step_data["rewards"] = rewards[None]
-                    step_data["dones"] = dones[None].astype(np.float32)
-                    rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
+                rets, lens = episode_stats_from_device(ep_stats)
+                for ep_ret, ep_len in zip(rets, lens):
+                    aggregator.update("Rewards/rew_avg", float(ep_ret))
+                    aggregator.update("Game/ep_len_avg", int(ep_len))
+        else:
+            with timer("Time/env_interaction_time"):
+                with jax.default_device(host):
+                    for _ in range(rollout_steps):
+                        policy_step += num_envs * fabric.num_processes
+                        dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
+                        actions, logprobs, _, player_key = policy_step_fn(
+                            player_params, dev_obs, player_key
+                        )
+                        actions_np = np.asarray(actions)
+                        next_obs, rewards, terminated, truncated, info = envs.step(
+                            actions_for_env(actions_np, act_space)
+                        )
+                        dones = np.logical_or(terminated, truncated)
+                        rewards = np.asarray(rewards, np.float32)
+                        if np.any(truncated):
+                            final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
+                            if final_obs is not None:
+                                padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+                                for k in obs_keys:
+                                    padded[k][truncated] = final_obs[k]
+                                vals = np.asarray(
+                                    values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys))
+                                )
+                                rewards[truncated] += gamma * vals[truncated]
 
-                    obs = next_obs
-                    for ep_ret, ep_len in episode_stats(info):
-                        aggregator.update("Rewards/rew_avg", ep_ret)
-                        aggregator.update("Game/ep_len_avg", ep_len)
+                        for k in obs_keys:
+                            step_data[k] = np.asarray(obs[k])[None]
+                        step_data["actions"] = actions_np[None]
+                        step_data["rewards"] = rewards[None]
+                        step_data["dones"] = dones[None].astype(np.float32)
+                        rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
 
-        with timer("Time/train_time"):
-            # donated device staging: host-numpy normalization + EXPLICIT
-            # device_puts (data/device_replay.stage_rollout), rollout donated
-            # into the one-dispatch update (see ppo.py)
-            local = rb.buffer
-            host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
-            host_rollout["actions"] = np.asarray(local["actions"])
-            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
-            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
-            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
-            host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
-            last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
-            with steady_guard(guard_on and update > start_iter):
-                params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
-            player_params = fabric.to_host(params)
+                        obs = next_obs
+                        for ep_ret, ep_len in episode_stats(info):
+                            aggregator.update("Rewards/rew_avg", ep_ret)
+                            aggregator.update("Game/ep_len_avg", ep_len)
 
-        if cfg.algo.anneal_lr:
+            with timer("Time/train_time"):
+                # donated device staging: host-numpy normalization + EXPLICIT
+                # device_puts (data/device_replay.stage_rollout), rollout donated
+                # into the one-dispatch update (see ppo.py)
+                local = rb.buffer
+                host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
+                host_rollout["actions"] = np.asarray(local["actions"])
+                host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+                host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+                rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
+                host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
+                last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
+                with steady_guard(guard_on and update > start_iter):
+                    params, opt_state, last_losses = train_phase(params, opt_state, rollout, last_obs_dev)
+                player_params = fabric.to_host(params)
+
+        # (Anakin mode anneals lr in-trace from the donated update counter)
+        if cfg.algo.anneal_lr and not use_anakin:
             new_lr = polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters)
             opt_state = set_learning_rate(opt_state, new_lr)
 
@@ -288,9 +367,14 @@ def main(fabric: Any, cfg: Any) -> None:
             fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
             break
 
-    envs.close()
+    if envs is not None:
+        envs.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
+        if use_anakin:
+            # the fused path never refreshes the host player copy — pull
+            # the final params once for the eval episode
+            player_params = fabric.to_host(params)
         test(agent, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

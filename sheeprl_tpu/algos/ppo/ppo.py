@@ -49,6 +49,7 @@ from sheeprl_tpu.algos.ppo.utils import (
 )
 from sheeprl_tpu.data.buffers import ReplayBuffer
 from sheeprl_tpu.data.device_replay import stage_rollout, stage_scalar, steady_guard
+from sheeprl_tpu.envs.jax.registry import anakin_enabled
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -111,15 +112,27 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # ---------------- environments -----------------------------------------
     num_envs = cfg.env.num_envs
-    envs = vectorize(
-        cfg,
-        [
-            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-    )
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
+    use_anakin = anakin_enabled(cfg, fabric)
+    if use_anakin:
+        # Anakin mode (envs/jax/anakin.py): the env lives INSIDE the
+        # compiled update — no vector-env processes exist at all
+        from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+        from sheeprl_tpu.envs.jax.registry import jax_env_from_cfg
+
+        envs = None
+        venv = VectorJaxEnv(jax_env_from_cfg(cfg), num_envs)
+        obs_space = venv.single_observation_space
+        act_space = venv.single_action_space
+    else:
+        envs = vectorize(
+            cfg,
+            [
+                make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+                for i in range(num_envs)
+            ],
+        )
+        obs_space = envs.single_observation_space
+        act_space = envs.single_action_space
     normalize_obs_keys(cfg, obs_space)
     actions_dim, is_continuous = spaces_to_dims(act_space)
     cnn_keys = tuple(cfg.algo.cnn_keys.encoder)
@@ -284,6 +297,7 @@ def main(fabric: Any, cfg: Any) -> None:
     # donate the STAGED rollout and bootstrap obs too (argnums 2/3): the one
     # dispatch consumes them exactly once, so XLA recycles their HBM for
     # activations instead of holding a dead copy across the update
+    train_phase_fn = train_phase  # raw callable: the Anakin path fuses it
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
@@ -338,19 +352,91 @@ def main(fabric: Any, cfg: Any) -> None:
     # the zero-implicit-H2D contract end to end)
     guard_on = bool(cfg.buffer.get("transfer_guard", False))
 
-    rb = ReplayBuffer(
-        rollout_steps,
-        num_envs,
-        memmap=cfg.buffer.memmap,
-        memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
-        obs_keys=obs_keys,
-    )
+    # ---------------- Anakin fused rollout+train ----------------------------
+    if use_anakin:
+        from sheeprl_tpu.envs.jax.anakin import (
+            init_actor_state,
+            make_rollout_fn,
+            traced_polynomial_decay,
+        )
+
+        def _sample(out, k):
+            return sample_actions(out, actions_dim, is_continuous, k, dist_type=dist_type)
+
+        rollout_fn = make_rollout_fn(
+            venv,
+            agent.apply,
+            _sample,
+            cnn_keys=cnn_keys,
+            mlp_keys=mlp_keys,
+            action_space=act_space,
+            gamma=gamma,
+            rollout_steps=rollout_steps,
+        )
+
+        def anakin_phase(p, o_state, actor, k):
+            """``lax.scan`` env rollout + GAE + all epochs/minibatches in
+            ONE device program.  Annealed coefficients are computed
+            in-trace from the donated update counter, so the steady state
+            performs zero host-to-device transfers of any kind."""
+            k_roll, k_train, k_next = jax.random.split(k, 3)
+            step0 = actor["update"]
+            clip = (
+                traced_polynomial_decay(step0, initial=initial_clip_coef, max_decay_steps=total_iters)
+                if cfg.algo.anneal_clip_coef
+                else jnp.float32(initial_clip_coef)
+            )
+            ent = (
+                traced_polynomial_decay(step0, initial=initial_ent_coef, max_decay_steps=total_iters)
+                if cfg.algo.anneal_ent_coef
+                else jnp.float32(initial_ent_coef)
+            )
+            if cfg.algo.anneal_lr:
+                o_state = set_learning_rate(
+                    o_state,
+                    traced_polynomial_decay(step0, initial=base_lr, max_decay_steps=total_iters, power=1.0),
+                )
+            actor, rollout, last_obs, stats = rollout_fn(p, actor, k_roll)
+            p, o_state, losses = train_phase_fn(
+                p,
+                o_state,
+                rollout,
+                last_obs,
+                k_train,
+                clip,
+                ent,
+                batch_size=global_bs,
+                num_minibatches=num_minibatches,
+                share_data=share_data,
+                n_shards=n_shards,
+            )
+            return p, o_state, actor, k_next, losses, stats
+
+        anakin_step = fabric.compile(
+            anakin_phase,
+            name=f"{cfg.algo.name}.anakin_phase",
+            donate_argnums=(0, 1, 2),
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+        actor_state = init_actor_state(
+            fabric, venv, jax.random.fold_in(key, fabric.global_rank + 1), start_iter - 1, sharded_envs
+        )
+        rb = None
+    else:
+        rb = ReplayBuffer(
+            rollout_steps,
+            num_envs,
+            memmap=cfg.buffer.memmap,
+            memmap_dir=os.path.join(log_dir, "memmap_buffer", f"rank_{rank}") if cfg.buffer.memmap else None,
+            obs_keys=obs_keys,
+        )
 
     # ---------------- main loop ---------------------------------------------
     step_data: Dict[str, np.ndarray] = {}
     # rank-offset: each process's envs must be distinct streams or
     # multi-host DP collects the same data num_processes times
-    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
+    if envs is not None:
+        obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
     last_losses = None
     # per-rank player key stream, advanced inside policy_step_fn; the main
     # `key` stays rank-identical for train dispatches
@@ -366,101 +452,122 @@ def main(fabric: Any, cfg: Any) -> None:
     profiler = ProfilerGate(cfg, log_dir)
     for update in range(start_iter, total_iters + 1):
         profiler.step(update)
-        with timer("Time/env_interaction_time"):
-            with jax.default_device(host):
-                for _ in range(rollout_steps):
-                    policy_step += num_envs * fabric.num_processes
-
-                    dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
-                    actions, logprobs, _, player_key = policy_step_fn(
-                        player_params, dev_obs, player_key
+        if use_anakin:
+            # -------- fused rollout+train: ONE dispatch per update ---------
+            with timer("Time/train_time"):
+                with steady_guard(guard_on and update > start_iter):
+                    params, opt_state, actor_state, key, last_losses, ep_stats = anakin_step(
+                        params, opt_state, actor_state, key
                     )
-                    actions_np = np.asarray(actions)
-                    next_obs, rewards, terminated, truncated, info = envs.step(
-                        actions_for_env(actions_np, act_space)
+                policy_step += num_envs * rollout_steps * fabric.num_processes
+            if cfg.metric.log_level > 0:
+                # completion arrays are tiny; the pull is D2H (legal under
+                # the H2D-scoped steady guard)
+                from sheeprl_tpu.envs.jax.anakin import episode_stats_from_device
+
+                rets, lens = episode_stats_from_device(ep_stats)
+                for ep_ret, ep_len in zip(rets, lens):
+                    aggregator.update("Rewards/rew_avg", float(ep_ret))
+                    aggregator.update("Game/ep_len_avg", int(ep_len))
+        else:
+            with timer("Time/env_interaction_time"):
+                with jax.default_device(host):
+                    for _ in range(rollout_steps):
+                        policy_step += num_envs * fabric.num_processes
+
+                        dev_obs = prepare_obs(obs, cnn_keys, mlp_keys)
+                        actions, logprobs, _, player_key = policy_step_fn(
+                            player_params, dev_obs, player_key
+                        )
+                        actions_np = np.asarray(actions)
+                        next_obs, rewards, terminated, truncated, info = envs.step(
+                            actions_for_env(actions_np, act_space)
+                        )
+                        dones = np.logical_or(terminated, truncated)
+                        rewards = np.asarray(rewards, np.float32)
+
+                        # truncation bootstrap: r += γ·V(real final obs)
+                        # (reference: ppo.py:287-306).  The final-obs batch is
+                        # padded to the full env count so values_fn keeps ONE
+                        # static shape (no per-count recompiles).
+                        if np.any(truncated):
+                            final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
+                            if final_obs is not None:
+                                padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
+                                for k in obs_keys:
+                                    padded[k][truncated] = final_obs[k]
+                                vals = np.asarray(
+                                    values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys))
+                                )
+                                rewards[truncated] += gamma * vals[truncated]
+
+                        for k in obs_keys:
+                            step_data[k] = np.asarray(obs[k])[None]
+                        step_data["actions"] = actions_np[None]
+                        step_data["logprobs"] = np.asarray(logprobs)[None]
+                        # values are NOT stored: train_phase recomputes them with
+                        # the same (unchanged) params in one batched forward
+                        step_data["rewards"] = rewards[None]
+                        step_data["dones"] = dones[None].astype(np.float32)
+                        rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
+
+                        obs = next_obs
+                        for ep_ret, ep_len in episode_stats(info):
+                            aggregator.update("Rewards/rew_avg", ep_ret)
+                            aggregator.update("Game/ep_len_avg", ep_len)
+
+            # ---------------- one-dispatch optimization -------------------------
+            with timer("Time/train_time"):
+                # donated device staging: the rollout is normalized on HOST
+                # numpy, staged with EXPLICIT device_puts (transfer-guard-clean,
+                # data/device_replay.stage_rollout) and donated into the train
+                # phase, which consumes it exactly once per dispatch — its HBM is
+                # recycled for activations.  buffer.transfer_guard=true arms
+                # jax.transfer_guard("disallow") around the dispatch to prove no
+                # implicit H2D rides along.
+                local = rb.buffer
+                host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
+                host_rollout["actions"] = np.asarray(local["actions"])
+                host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
+                host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+                host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+                # multi-host: each process contributes its local env rows and the
+                # global batch is their concatenation (axis=1); single-process
+                # replicates (env-axis minibatch gathers are cheapest replicated)
+                rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
+                host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
+                last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
+                key, tk = jax.random.split(key)
+                clip_dev = stage_scalar(clip_coef_v)
+                ent_dev = stage_scalar(ent_coef_v)
+                with steady_guard(guard_on and update > start_iter):
+                    params, opt_state, last_losses = train_phase(
+                        params,
+                        opt_state,
+                        rollout,
+                        last_obs_dev,
+                        tk,
+                        clip_dev,
+                        ent_dev,
+                        batch_size=global_bs,
+                        num_minibatches=num_minibatches,
+                        share_data=share_data,
+                        n_shards=n_shards,
                     )
-                    dones = np.logical_or(terminated, truncated)
-                    rewards = np.asarray(rewards, np.float32)
-
-                    # truncation bootstrap: r += γ·V(real final obs)
-                    # (reference: ppo.py:287-306).  The final-obs batch is
-                    # padded to the full env count so values_fn keeps ONE
-                    # static shape (no per-count recompiles).
-                    if np.any(truncated):
-                        final_obs = final_obs_rows(info, np.nonzero(truncated)[0], obs_keys)
-                        if final_obs is not None:
-                            padded = {k: np.asarray(next_obs[k]).copy() for k in obs_keys}
-                            for k in obs_keys:
-                                padded[k][truncated] = final_obs[k]
-                            vals = np.asarray(
-                                values_fn(player_params, prepare_obs(padded, cnn_keys, mlp_keys))
-                            )
-                            rewards[truncated] += gamma * vals[truncated]
-
-                    for k in obs_keys:
-                        step_data[k] = np.asarray(obs[k])[None]
-                    step_data["actions"] = actions_np[None]
-                    step_data["logprobs"] = np.asarray(logprobs)[None]
-                    # values are NOT stored: train_phase recomputes them with
-                    # the same (unchanged) params in one batched forward
-                    step_data["rewards"] = rewards[None]
-                    step_data["dones"] = dones[None].astype(np.float32)
-                    rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step_data.items()})
-
-                    obs = next_obs
-                    for ep_ret, ep_len in episode_stats(info):
-                        aggregator.update("Rewards/rew_avg", ep_ret)
-                        aggregator.update("Game/ep_len_avg", ep_len)
-
-        # ---------------- one-dispatch optimization -------------------------
-        with timer("Time/train_time"):
-            # donated device staging: the rollout is normalized on HOST
-            # numpy, staged with EXPLICIT device_puts (transfer-guard-clean,
-            # data/device_replay.stage_rollout) and donated into the train
-            # phase, which consumes it exactly once per dispatch — its HBM is
-            # recycled for activations.  buffer.transfer_guard=true arms
-            # jax.transfer_guard("disallow") around the dispatch to prove no
-            # implicit H2D rides along.
-            local = rb.buffer
-            host_rollout = {k: obs_to_np(local[k], k in cnn_keys, rollout=True) for k in obs_keys}
-            host_rollout["actions"] = np.asarray(local["actions"])
-            host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
-            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
-            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
-            # multi-host: each process contributes its local env rows and the
-            # global batch is their concatenation (axis=1); single-process
-            # replicates (env-axis minibatch gathers are cheapest replicated)
-            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
-            host_last = {k: obs_to_np(np.asarray(obs[k]), k in cnn_keys) for k in obs_keys}
-            last_obs_dev = stage_rollout(fabric, host_last, axis=0, sharded=sharded_envs)
-            key, tk = jax.random.split(key)
-            clip_dev = stage_scalar(clip_coef_v)
-            ent_dev = stage_scalar(ent_coef_v)
-            with steady_guard(guard_on and update > start_iter):
-                params, opt_state, last_losses = train_phase(
-                    params,
-                    opt_state,
-                    rollout,
-                    last_obs_dev,
-                    tk,
-                    clip_dev,
-                    ent_dev,
-                    batch_size=global_bs,
-                    num_minibatches=num_minibatches,
-                    share_data=share_data,
-                    n_shards=n_shards,
-                )
-            # refresh the host player once per iteration (one d2h transfer)
-            player_params = fabric.to_host(params)
+                # refresh the host player once per iteration (one d2h transfer)
+                player_params = fabric.to_host(params)
 
         # ---------------- schedules -----------------------------------------
-        if cfg.algo.anneal_lr:
-            new_lr = polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters, power=1.0)
-            opt_state = set_learning_rate(opt_state, new_lr)
-        if cfg.algo.anneal_clip_coef:
-            clip_coef_v = polynomial_decay(update, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters)
-        if cfg.algo.anneal_ent_coef:
-            ent_coef_v = polynomial_decay(update, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters)
+        # (Anakin mode anneals in-trace from the donated update counter —
+        # host-side annealing would be a per-update H2D write)
+        if not use_anakin:
+            if cfg.algo.anneal_lr:
+                new_lr = polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters, power=1.0)
+                opt_state = set_learning_rate(opt_state, new_lr)
+            if cfg.algo.anneal_clip_coef:
+                clip_coef_v = polynomial_decay(update, initial=initial_clip_coef, final=0.0, max_decay_steps=total_iters)
+            if cfg.algo.anneal_ent_coef:
+                ent_coef_v = polynomial_decay(update, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters)
 
         # ---------------- logging --------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -499,9 +606,14 @@ def main(fabric: Any, cfg: Any) -> None:
             break
 
     profiler.close()
-    envs.close()
+    if envs is not None:
+        envs.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
+        if use_anakin:
+            # the fused path never refreshes the host player copy — pull
+            # the final params once for the eval episode
+            player_params = fabric.to_host(params)
         test(agent, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

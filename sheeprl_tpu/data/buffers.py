@@ -572,7 +572,7 @@ class EnvIndependentReplayBuffer:
                 if k in data:
                     self._mirror.write(k, data[k][-write_pos.shape[0]:], write_pos, env_sel)
 
-    def sample(self, batch_size: int, n_samples: int = 1, **kwargs: Any) -> Arrays:
+    def sample(self, batch_size: int, n_samples: int = 1, track_indices: bool = False, **kwargs: Any) -> Arrays:
         if batch_size <= 0 or n_samples <= 0:
             raise ValueError("batch_size and n_samples must be positive")
         # only sub-buffers able to serve the request get sampling mass
@@ -584,17 +584,28 @@ class EnvIndependentReplayBuffer:
             raise RuntimeError("Cannot sample from an empty buffer")
         probs = occupied / occupied.sum()
         counts = np.random.multinomial(batch_size, probs)
+        # index tracking feeds device-side gathers at the SAME draw
+        # (DeviceReplay.gather_at); explicit `track_indices=True` replaces
+        # the old implicit mirror-attached gate
+        track = track_indices or self._mirror is not None
+        if track and self._buffer_cls is not SequentialReplayBuffer:
+            # only sequential sub-buffers record their drawn ring slots
+            # (last_sequence_indices) — same constraint attach_mirror enforced
+            raise ValueError(
+                "track_indices requires SequentialReplayBuffer sub-buffers "
+                "(uniform sub-buffers do not record sampled ring slots)"
+            )
         parts: List[Arrays] = []
         idx_parts: List[np.ndarray] = []
         env_parts: List[np.ndarray] = []
         for env, (b, c) in enumerate(zip(self._buffers, counts)):
             if c > 0:
                 parts.append(b.sample(int(c), n_samples=n_samples, **kwargs))
-                if self._mirror is not None:
+                if track:
                     t_idx = b.last_sequence_indices  # (U, L, c)
                     idx_parts.append(t_idx)
                     env_parts.append(np.full_like(t_idx, env))
-        if self._mirror is not None and idx_parts:
+        if track and idx_parts:
             self.last_sample_indices = (
                 np.concatenate(idx_parts, axis=2),
                 np.concatenate(env_parts, axis=2),

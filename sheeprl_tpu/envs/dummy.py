@@ -4,6 +4,16 @@ Parity with the reference's dummy envs (reference: sheeprl/envs/dummy.py:8-108):
 Dict observations (an ``rgb`` image + a ``state`` vector), fixed-length
 episodes, and discrete / multi-discrete / continuous action variants.  Images
 are channel-last ``(H, W, C)`` (the TPU-native layout used framework-wide).
+
+Env-contract note (ISSUE 11, scenario matrix): the dummy family exposes the
+SAME seeding/auto-reset surface as the gym and jax env families —
+``reset(seed=)`` seeds ``np_random`` and (with ``random_start=True``)
+yields seed-reproducible, seed-distinct trajectories; through
+``utils.env.vectorize`` the SAME_STEP auto-reset surfaces
+``final_obs``/``final_info`` exactly like any other env.  The DEFAULTS stay
+bit-identical to the historical behavior (step counter from 0, fixed-length
+episodes ending in ``terminated``): the golden/regression fixtures train on
+these envs and must not drift.
 """
 
 from __future__ import annotations
@@ -19,9 +29,20 @@ class _DummyEnv(gym.Env):
     metadata = {"render_modes": ["rgb_array"]}
     render_mode = "rgb_array"
 
-    def __init__(self, image_size: Tuple[int, int, int] = (64, 64, 3), episode_len: int = 128):
+    def __init__(
+        self,
+        image_size: Tuple[int, int, int] = (64, 64, 3),
+        episode_len: int = 128,
+        random_start: bool = False,
+    ):
         self._image_size = image_size
         self._episode_len = episode_len
+        # random_start=False (default) keeps the historical deterministic
+        # trajectories (goldens); True makes seeding OBSERVABLE — the step
+        # counter starts at a seeded draw, so same-seed resets reproduce
+        # and different seeds diverge (the contract the scenario matrix
+        # asserts across all three env families)
+        self._random_start = bool(random_start)
         self._step = 0
         self.observation_space = spaces.Dict(
             {
@@ -39,7 +60,9 @@ class _DummyEnv(gym.Env):
 
     def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
         super().reset(seed=seed)
-        self._step = 0
+        self._step = (
+            int(self.np_random.integers(self._episode_len // 2)) if self._random_start else 0
+        )
         return self._obs(), {}
 
     def step(self, action: Any):

@@ -1,0 +1,24 @@
+"""Pure-JAX vectorized environments + Anakin fused rollouts.
+
+See docs/jax_envs.md for the env authoring contract, the adapter path, and
+the fused-rollout design.
+"""
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, VectorJaxEnv
+from sheeprl_tpu.envs.jax.registry import (
+    JAX_ENVS,
+    anakin_enabled,
+    is_jax_native,
+    jax_env_from_cfg,
+    make_jax_env,
+)
+
+__all__ = [
+    "JaxEnv",
+    "VectorJaxEnv",
+    "JAX_ENVS",
+    "anakin_enabled",
+    "is_jax_native",
+    "jax_env_from_cfg",
+    "make_jax_env",
+]

@@ -1,0 +1,81 @@
+"""``JaxToGymAdapter`` — run any pure-JAX env through the gymnasium API.
+
+This is the compatibility half of the ``envs/jax`` design: every EXISTING
+algo loop (on- and off-policy, coupled and decoupled) can select
+``env=jax_*`` and run unmodified — the adapter slots into ``make_env``'s
+wrapper pipeline like any other suite, and the vector wrappers
+(``SyncVectorEnv``/``AsyncVectorEnv`` with SAME_STEP autoreset) provide
+``final_obs``/``final_info`` exactly as for CPU gym envs.
+
+Seeding follows the gymnasium contract: ``reset(seed=s)`` derives the env's
+JAX PRNG stream from ``s`` (reproducible trajectories per seed); unseeded
+resets continue the stream.  The per-step ``step``/``reset`` programs are
+jitted once (tiny, shape-stable).
+
+The jax_* env groups default to ``sync_env: true``: stepping one JAX
+program per env instance inside forked ``AsyncVectorEnv`` workers would
+re-initialize a JAX runtime per worker for envs that are *cheaper than the
+IPC round-trip* — and the real speed path is the fused Anakin rollout, not
+the adapter.  The adapter exists for correctness/compatibility, and the
+scenario matrix runs it on every algo family.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import gymnasium as gym
+import jax
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import JaxEnv
+
+
+class JaxToGymAdapter(gym.Env):
+    metadata = {"render_modes": ["rgb_array"]}
+    render_mode = "rgb_array"
+
+    def __init__(self, env: JaxEnv, seed: Optional[int] = None):
+        self._env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+        self._step_fn = jax.jit(env.step)
+        self._reset_fn = jax.jit(env.reset)
+        self._state: Any = None
+        self._key: Optional[jax.Array] = None
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+
+    def _next_key(self) -> jax.Array:
+        if self._key is None:
+            # no seed ever provided: draw one from gymnasium's np_random so
+            # the standard `env.reset(seed=...)` machinery governs it
+            self._key = jax.random.PRNGKey(int(self.np_random.integers(2**31 - 1)))
+        self._key, k = jax.random.split(self._key)
+        return k
+
+    def _host_obs(self, obs: Dict[str, jax.Array]) -> Dict[str, np.ndarray]:
+        return {k: np.asarray(v) for k, v in obs.items()}
+
+    def reset(self, *, seed: Optional[int] = None, options: Optional[dict] = None):
+        super().reset(seed=seed)
+        if seed is not None:
+            self._key = jax.random.PRNGKey(int(seed))
+        self._state, obs = self._reset_fn(self._next_key())
+        return self._host_obs(obs), {}
+
+    def step(self, action: Any):
+        action = np.asarray(action)
+        self._state, obs, reward, terminated, truncated = self._step_fn(self._state, action)
+        return (
+            self._host_obs(obs),
+            float(reward),
+            bool(terminated),
+            bool(truncated),
+            {},
+        )
+
+    def render(self) -> Optional[np.ndarray]:
+        if self._state is not None and "rgb" in self.observation_space.spaces:
+            return np.asarray(self._env.observe(self._state)["rgb"])
+        return None

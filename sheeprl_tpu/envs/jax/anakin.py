@@ -1,0 +1,191 @@
+"""Anakin fused rollouts: the environment inside the compiled update.
+
+Podracer's Anakin architecture (arXiv:2104.06272) co-locates env stepping
+and learning on the chip: a ``lax.scan`` over the batched pure-env step +
+policy inference produces the whole rollout as device arrays, which the
+algo's existing train phase consumes in the SAME ``fabric.compile``
+executable.  Per update there is ONE dispatch and ZERO host↔device data
+motion — no Python env workers, no observation shipping, no rollout
+staging.  This is the structural answer to the BENCH_TPU.md honest
+negative (classic-control PPO/SAC ran slower on-chip than on host: the
+chip idled while ``AsyncVectorEnv`` stepped CPU gym processes).
+
+The pieces:
+
+* :func:`make_rollout_fn` — builds the jit-traceable rollout half:
+  ``rollout(params, actor, key) -> (actor', rollout, last_obs, stats)``.
+  ``actor`` is the persistent device-resident carry (batched ``EnvState``
+  + episode accounting + the update counter), donated into each fused
+  dispatch so env state lives in HBM across the whole run.
+* :func:`init_actor_state` — resets the vector env and stages the carry
+  onto the mesh: env-state leaves shard over the ``data`` axis along the
+  env dimension (the ``fabric.shard_batch`` layout the train phase's
+  minibatch gathers expect), exactly like the PR 9 replay ring.
+* :func:`traced_polynomial_decay` — the in-trace twin of
+  ``utils.polynomial_decay`` so annealed coefficients (clip/entropy/lr)
+  are computed ON DEVICE from the donated update counter: a steady state
+  under ``jax.transfer_guard_host_to_device("disallow")`` performs zero
+  H2D transfers, explicit or implicit.
+
+Rollout semantics match the host loops: SAME_STEP auto-reset (via
+:class:`~sheeprl_tpu.envs.jax.core.VectorJaxEnv`), truncation bootstrap
+``r += γ·V(final_obs)`` on truncated rows with the current params, dones =
+terminated | truncated, observations stored pre-normalized (uint8 images →
+float32/255) in the layout the train phases already consume.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Sequence, Tuple
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+
+
+def traced_polynomial_decay(
+    step: jax.Array, *, initial: float, final: float = 0.0, max_decay_steps: int = 100, power: float = 1.0
+) -> jax.Array:
+    """In-trace twin of ``utils.utils.polynomial_decay`` over a device step
+    counter (clamped past ``max_decay_steps``, like the host version)."""
+    frac = jnp.clip(1.0 - step.astype(jnp.float32) / float(max_decay_steps), 0.0, 1.0) ** power
+    return jnp.float32((initial - final)) * frac + jnp.float32(final)
+
+
+def prep_obs_fn(cnn_keys: Sequence[str], mlp_keys: Sequence[str]) -> Callable:
+    """Device-side observation normalization: the traced twin of
+    ``ppo.utils.obs_to_np`` (uint8 images → float32/255, vectors →
+    float32).  Jax envs don't frame-stack, so no merge branch."""
+
+    def prep(obs: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        out = {}
+        for k in cnn_keys:
+            out[k] = obs[k].astype(jnp.float32) / 255.0
+        for k in mlp_keys:
+            out[k] = obs[k].astype(jnp.float32)
+        return out
+
+    return prep
+
+
+def env_actions_fn(action_space: gym.Space) -> Callable:
+    """Traced twin of ``ppo.utils.actions_for_env``: stored float actions →
+    what the env step consumes."""
+    if isinstance(action_space, gym.spaces.Discrete):
+        return lambda a: a[..., 0].astype(jnp.int32)
+    if isinstance(action_space, gym.spaces.MultiDiscrete):
+        return lambda a: a.astype(jnp.int32)
+    low = np.asarray(action_space.low, np.float32)
+    high = np.asarray(action_space.high, np.float32)
+    return lambda a: jnp.clip(a.astype(jnp.float32), low, high)
+
+
+def init_actor_state(fabric: Any, venv: VectorJaxEnv, key: jax.Array, start_update: int, sharded: bool) -> Dict[str, Any]:
+    """Reset the batched env and stage the persistent actor carry onto the
+    mesh: env-dimension leaves shard over ``data`` via the sharding
+    engine's env-state spec (``parallel/sharding.env_state_sharding`` —
+    the replay-ring placement, one axis earlier) when the env count
+    divides the data degree, else replicate."""
+    from sheeprl_tpu.parallel.sharding import env_state_sharding
+
+    env_state, _ = venv.reset(key)
+    actor = {
+        "env": env_state,
+        "ep_ret": jnp.zeros((venv.num_envs,), jnp.float32),
+        "ep_len": jnp.zeros((venv.num_envs,), jnp.int32),
+    }
+    placement = (
+        env_state_sharding(fabric.mesh, venv.num_envs, fabric.data_axis)
+        if sharded
+        else fabric.replicated
+    )
+    actor = jax.device_put(actor, placement)
+    actor["update"] = fabric.replicate(jnp.asarray(start_update, jnp.int32))
+    return actor
+
+
+def make_rollout_fn(
+    venv: VectorJaxEnv,
+    agent_apply: Callable,
+    sample_fn: Callable,
+    *,
+    cnn_keys: Sequence[str],
+    mlp_keys: Sequence[str],
+    action_space: gym.Space,
+    gamma: float,
+    rollout_steps: int,
+    store_logprobs: bool = True,
+) -> Callable:
+    """Build ``rollout(p, actor, key) -> (actor', rollout, last_obs, stats)``.
+
+    ``rollout`` leaves are ``(T, B, *feat)`` in the exact layout the
+    on-policy train phases consume (obs pre-normalized, actions in storage
+    float layout, rewards truncation-bootstrapped, dones float).  ``stats``
+    carries per-step ``(T, B)`` episode-completion arrays — small, pulled
+    D2H by the loop for logging (legal under the H2D-scoped guard).
+    """
+    prep = prep_obs_fn(cnn_keys, mlp_keys)
+    to_env = env_actions_fn(action_space)
+    obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
+
+    def rollout(p: Any, actor: Dict[str, Any], key: jax.Array):
+        def body(carry, k_step):
+            env_state, ep_ret, ep_len = carry
+            pobs = prep(venv.observe(env_state))
+            out, value = agent_apply(p, pobs)
+            actions, logprob, _ = sample_fn(out, k_step)
+            env_state, _, reward, term, trunc, final_obs = venv.step(env_state, to_env(actions))
+            # truncation bootstrap with the CURRENT params (the host loops'
+            # `rewards[truncated] += gamma * V(final_obs)` — here final_obs
+            # is always available, no padded re-dispatch needed)
+            _, v_final = agent_apply(p, prep(final_obs))
+            trunc_f = trunc.astype(jnp.float32)
+            boot_reward = reward + gamma * v_final[..., 0] * trunc_f
+            done = jnp.logical_or(term, trunc)
+            done_f = done.astype(jnp.float32)
+            ep_ret = ep_ret + reward
+            ep_len = ep_len + 1
+            step_out = {
+                **{k: pobs[k] for k in obs_keys},
+                "actions": actions,
+                "logprobs": logprob,
+                "rewards": boot_reward,
+                "dones": done_f,
+                "ep_done": done,
+                "ep_ret": ep_ret,
+                "ep_len": ep_len,
+            }
+            ep_ret = ep_ret * (1.0 - done_f)
+            ep_len = ep_len * (1 - done.astype(jnp.int32))
+            return (env_state, ep_ret, ep_len), step_out
+
+        keys = jax.random.split(key, rollout_steps)
+        (env_state, ep_ret, ep_len), traj = jax.lax.scan(
+            body, (actor["env"], actor["ep_ret"], actor["ep_len"]), keys
+        )
+        stats = {k: traj.pop(k) for k in ("ep_done", "ep_ret", "ep_len")}
+        if not store_logprobs:
+            traj.pop("logprobs")
+        last_obs = prep(venv.observe(env_state))
+        new_actor = {
+            "env": env_state,
+            "ep_ret": ep_ret,
+            "ep_len": ep_len,
+            "update": actor["update"] + 1,
+        }
+        return new_actor, traj, last_obs, stats
+
+    return rollout
+
+
+def episode_stats_from_device(stats: Dict[str, jax.Array]) -> Tuple[np.ndarray, np.ndarray]:
+    """Pull the per-step completion arrays D2H and flatten to the finished
+    episodes' ``(returns, lengths)`` — the fused path's counterpart of
+    ``utils.env.episode_stats``."""
+    done = np.asarray(stats["ep_done"]).reshape(-1)
+    rets = np.asarray(stats["ep_ret"]).reshape(-1)[done]
+    lens = np.asarray(stats["ep_len"]).reshape(-1)[done]
+    return rets, lens

@@ -1,0 +1,102 @@
+"""Pure-JAX CartPole, transition-exact against Gymnasium ``CartPole-v1``.
+
+Dynamics, constants, termination thresholds and reward are copied from
+gymnasium's ``classic_control/cartpole.py`` (Euler integration, 12° pole /
+2.4m cart limits, +1 reward every step including the terminating one) so
+seeded transition-parity tests can assert equality within float tolerance
+(tests/test_envs/test_jax_envs.py).  The 500-step ``TimeLimit`` wrapper of
+the gym registration becomes an in-env ``truncated`` flag — inside a
+``lax.scan`` there is no wrapper to do it.
+
+Reset draws all four state components from U(-0.05, 0.05) like gymnasium;
+the PRNG differs (threefry vs PCG64), so parity tests pin transitions from
+explicit states rather than comparing seeded reset draws.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, Obs
+
+
+class CartPoleState(NamedTuple):
+    x: jax.Array  # cart position
+    x_dot: jax.Array  # cart velocity
+    theta: jax.Array  # pole angle (rad)
+    theta_dot: jax.Array  # pole angular velocity
+    t: jax.Array  # step counter (int32)
+    key: jax.Array  # per-instance PRNG stream
+
+
+class JaxCartPole(JaxEnv):
+    GRAVITY = 9.8
+    MASSCART = 1.0
+    MASSPOLE = 0.1
+    TOTAL_MASS = MASSPOLE + MASSCART
+    LENGTH = 0.5  # half the pole's length
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG = 10.0
+    TAU = 0.02
+    THETA_THRESHOLD = 12 * 2 * math.pi / 360
+    X_THRESHOLD = 2.4
+
+    def __init__(self, max_episode_steps: int = 500):
+        self.max_episode_steps = int(max_episode_steps)
+        high = np.array(
+            [self.X_THRESHOLD * 2, np.inf, self.THETA_THRESHOLD * 2, np.inf],
+            dtype=np.float32,
+        )
+        self.observation_space = spaces.Dict({"state": spaces.Box(-high, high, dtype=np.float32)})
+        self.action_space = spaces.Discrete(2)
+
+    def reset(self, key: jax.Array) -> Tuple[CartPoleState, Obs]:
+        k_init, k_carry = jax.random.split(key)
+        init = jax.random.uniform(k_init, (4,), minval=-0.05, maxval=0.05, dtype=jnp.float32)
+        state = CartPoleState(
+            x=init[0], x_dot=init[1], theta=init[2], theta_dot=init[3],
+            t=jnp.zeros((), jnp.int32), key=k_carry,
+        )
+        return state, self.observe(state)
+
+    def observe(self, state: CartPoleState) -> Obs:
+        return {
+            "state": jnp.stack([state.x, state.x_dot, state.theta, state.theta_dot]).astype(
+                jnp.float32
+            )
+        }
+
+    def step(self, state: CartPoleState, action: jax.Array):
+        force = jnp.where(action.astype(jnp.int32) == 1, self.FORCE_MAG, -self.FORCE_MAG)
+        costheta = jnp.cos(state.theta)
+        sintheta = jnp.sin(state.theta)
+        # gymnasium's Euler step, verbatim
+        temp = (force + self.POLEMASS_LENGTH * state.theta_dot**2 * sintheta) / self.TOTAL_MASS
+        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
+            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        )
+        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+        x = state.x + self.TAU * state.x_dot
+        x_dot = state.x_dot + self.TAU * xacc
+        theta = state.theta + self.TAU * state.theta_dot
+        theta_dot = state.theta_dot + self.TAU * thetaacc
+        t = state.t + 1
+
+        terminated = (
+            (jnp.abs(x) > self.X_THRESHOLD) | (jnp.abs(theta) > self.THETA_THRESHOLD)
+        )
+        truncated = jnp.logical_and(t >= self.max_episode_steps, jnp.logical_not(terminated))
+        new_state = CartPoleState(x=x, x_dot=x_dot, theta=theta, theta_dot=theta_dot, t=t, key=state.key)
+        return (
+            new_state,
+            self.observe(new_state),
+            jnp.float32(1.0),  # +1 every step, including the terminating one
+            terminated,
+            truncated,
+        )

@@ -1,0 +1,107 @@
+"""Procedural pixel foraging gridworld — the CNN-path exercise env.
+
+A ``grid × grid`` world rendered fully in-trace onto an ``(H, W, 3)`` uint8
+image (channel-last, the TPU-native layout): the agent is a white cell,
+food cells are green.  Each reset procedurally scatters ``n_food`` food
+cells and the agent start from the instance's PRNG key (a permutation of
+the cell grid, so placements never collide).  Actions are
+noop/up/down/left/right; eating a food cell pays +1; the episode
+*terminates* when all food is eaten and *truncates* at
+``max_episode_steps`` — so both gymnasium end-of-episode flags get real
+coverage on the pixel path.
+
+The position and remaining food appear ONLY in the pixels (no state
+vector), so a policy can beat random exclusively through its CNN trunk —
+same design teeth as ``PixelGridDummyEnv``, but pure-JAX and procedurally
+seeded per episode.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, Obs
+
+# noop/up/down/left/right
+_MOVES = np.array([[0, 0], [-1, 0], [1, 0], [0, -1], [0, 1]], dtype=np.int32)
+
+
+class ForageState(NamedTuple):
+    pos: jax.Array  # (2,) int32 agent cell (row, col)
+    food: jax.Array  # (grid, grid) bool remaining food
+    t: jax.Array  # step counter (int32)
+    key: jax.Array  # per-instance PRNG stream
+
+
+class JaxForage(JaxEnv):
+    def __init__(
+        self,
+        grid: int = 8,
+        n_food: int = 6,
+        image_hw: int = 64,
+        max_episode_steps: int = 128,
+    ):
+        if image_hw % grid != 0:
+            raise ValueError(f"image_hw ({image_hw}) must be a multiple of grid ({grid})")
+        if n_food >= grid * grid:
+            raise ValueError(f"n_food ({n_food}) must leave room for the agent on a {grid}x{grid} grid")
+        self.grid = int(grid)
+        self.n_food = int(n_food)
+        self.image_hw = int(image_hw)
+        self.cell = self.image_hw // self.grid
+        self.max_episode_steps = int(max_episode_steps)
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(0, 255, (image_hw, image_hw, 3), np.uint8)}
+        )
+        self.action_space = spaces.Discrete(5)
+
+    def reset(self, key: jax.Array) -> Tuple[ForageState, Obs]:
+        k_place, k_carry = jax.random.split(key)
+        # one permutation of the cell grid: slot 0 is the agent, the next
+        # n_food slots are food — procedural placement with no collisions
+        cells = jax.random.permutation(k_place, self.grid * self.grid)
+        agent = cells[0]
+        pos = jnp.stack([agent // self.grid, agent % self.grid]).astype(jnp.int32)
+        food = (
+            jnp.zeros((self.grid * self.grid,), bool)
+            .at[cells[1 : 1 + self.n_food]]
+            .set(True)
+            .reshape(self.grid, self.grid)
+        )
+        state = ForageState(pos=pos, food=food, t=jnp.zeros((), jnp.int32), key=k_carry)
+        return state, self.observe(state)
+
+    def observe(self, state: ForageState) -> Obs:
+        # (G, G, 3) uint8 cell image: green food, white agent (agent wins
+        # the cell it stands on), upsampled to (H, W, 3) by pixel repeat
+        food = state.food[..., None] * jnp.array([0, 255, 0], jnp.uint8)
+        agent = (
+            jnp.zeros((self.grid, self.grid), bool)
+            .at[state.pos[0], state.pos[1]]
+            .set(True)
+        )
+        img = jnp.where(agent[..., None], jnp.uint8(255), food)
+        img = jnp.repeat(jnp.repeat(img, self.cell, axis=0), self.cell, axis=1)
+        return {"rgb": img}
+
+    def step(self, state: ForageState, action: jax.Array):
+        move = jnp.asarray(_MOVES)[action.astype(jnp.int32) % 5]
+        pos = jnp.clip(state.pos + move, 0, self.grid - 1)
+        ate = state.food[pos[0], pos[1]]
+        food = state.food.at[pos[0], pos[1]].set(False)
+        t = state.t + 1
+        new_state = ForageState(pos=pos, food=food, t=t, key=state.key)
+        terminated = ~jnp.any(food)
+        truncated = jnp.logical_and(t >= self.max_episode_steps, jnp.logical_not(terminated))
+        return (
+            new_state,
+            self.observe(new_state),
+            ate.astype(jnp.float32),
+            terminated,
+            truncated,
+        )

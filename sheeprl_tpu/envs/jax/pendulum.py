@@ -1,0 +1,85 @@
+"""Pure-JAX Pendulum, transition-exact against Gymnasium ``Pendulum-v1``.
+
+Constants and dynamics follow gymnasium's ``classic_control/pendulum.py``
+(g=10.0 default, semi-implicit Euler with speed clipping, quadratic cost on
+normalized angle / speed / torque).  The 200-step TimeLimit becomes an
+in-env ``truncated`` flag; the env never terminates.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, Obs
+
+
+def angle_normalize(x: jax.Array) -> jax.Array:
+    return ((x + jnp.pi) % (2 * jnp.pi)) - jnp.pi
+
+
+class PendulumState(NamedTuple):
+    theta: jax.Array
+    theta_dot: jax.Array
+    t: jax.Array  # step counter (int32)
+    key: jax.Array  # per-instance PRNG stream
+
+
+class JaxPendulum(JaxEnv):
+    MAX_SPEED = 8.0
+    MAX_TORQUE = 2.0
+    DT = 0.05
+    G = 10.0
+    M = 1.0
+    L = 1.0
+
+    def __init__(self, max_episode_steps: int = 200, g: float = 10.0):
+        self.max_episode_steps = int(max_episode_steps)
+        self.g = float(g)
+        high = np.array([1.0, 1.0, self.MAX_SPEED], dtype=np.float32)
+        self.observation_space = spaces.Dict({"state": spaces.Box(-high, high, dtype=np.float32)})
+        self.action_space = spaces.Box(-self.MAX_TORQUE, self.MAX_TORQUE, (1,), np.float32)
+
+    def reset(self, key: jax.Array) -> Tuple[PendulumState, Obs]:
+        k_init, k_carry = jax.random.split(key)
+        init = jax.random.uniform(
+            k_init, (2,),
+            minval=jnp.array([-math.pi, -1.0]),
+            maxval=jnp.array([math.pi, 1.0]),
+            dtype=jnp.float32,
+        )
+        state = PendulumState(
+            theta=init[0], theta_dot=init[1], t=jnp.zeros((), jnp.int32), key=k_carry
+        )
+        return state, self.observe(state)
+
+    def observe(self, state: PendulumState) -> Obs:
+        return {
+            "state": jnp.stack(
+                [jnp.cos(state.theta), jnp.sin(state.theta), state.theta_dot]
+            ).astype(jnp.float32)
+        }
+
+    def step(self, state: PendulumState, action: jax.Array):
+        u = jnp.clip(action.reshape(()), -self.MAX_TORQUE, self.MAX_TORQUE)
+        th, thdot = state.theta, state.theta_dot
+        costs = angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
+        newthdot = thdot + (
+            3.0 * self.g / (2.0 * self.L) * jnp.sin(th) + 3.0 / (self.M * self.L**2) * u
+        ) * self.DT
+        newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
+        newth = th + newthdot * self.DT
+        t = state.t + 1
+        new_state = PendulumState(theta=newth, theta_dot=newthdot, t=t, key=state.key)
+        return (
+            new_state,
+            self.observe(new_state),
+            -costs.astype(jnp.float32),
+            jnp.zeros((), bool),  # pendulum never terminates
+            t >= self.max_episode_steps,
+        )

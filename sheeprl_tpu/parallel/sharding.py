@@ -58,6 +58,8 @@ __all__ = [
     "spec_from_config",
     "replay_partition_spec",
     "replay_sharding",
+    "env_state_partition_spec",
+    "env_state_sharding",
     "DREAMER_V3_RULES",
     "RULE_TABLES",
 ]
@@ -91,6 +93,32 @@ def replay_partition_spec(n_envs: int, mesh: Optional[Mesh], data_axis: str = "d
 def replay_sharding(mesh: Mesh, n_envs: int, data_axis: str = "data") -> NamedSharding:
     """``NamedSharding`` form of :func:`replay_partition_spec` on ``mesh``."""
     return NamedSharding(mesh, replay_partition_spec(n_envs, mesh, data_axis))
+
+
+# --------------------------------------------------------------------------
+# Anakin env-state shardings (envs/jax/anakin.py)
+# --------------------------------------------------------------------------
+
+def env_state_partition_spec(n_envs: int, mesh: Optional[Mesh], data_axis: str = "data") -> P:
+    """``PartitionSpec`` for a batched ``EnvState`` pytree ``(n_envs, *)``.
+
+    The LEADING axis of every env-state leaf is the env instance axis; it
+    shards over the mesh ``data`` axis so each device steps its own env
+    rows inside the fused Anakin rollout — the same placement the fused
+    train phase's minibatch gathers expect (and the replay ring uses, one
+    axis earlier).  Indivisible env counts replicate, mirroring
+    :func:`replay_partition_spec`'s rule."""
+    if mesh is None or data_axis not in mesh.shape:
+        return P()
+    n_data = int(mesh.shape[data_axis])
+    if n_data <= 1 or int(n_envs) % n_data != 0:
+        return P()
+    return P(data_axis)
+
+
+def env_state_sharding(mesh: Mesh, n_envs: int, data_axis: str = "data") -> NamedSharding:
+    """``NamedSharding`` form of :func:`env_state_partition_spec`."""
+    return NamedSharding(mesh, env_state_partition_spec(n_envs, mesh, data_axis))
 
 
 # --------------------------------------------------------------------------

@@ -43,10 +43,10 @@ DUMMY_ENVS = {
 }
 
 
-def get_dummy_env(env_id: str) -> gym.Env:
+def get_dummy_env(env_id: str, **kwargs: Any) -> gym.Env:
     if env_id not in DUMMY_ENVS:
         raise ValueError(f"Unknown dummy env '{env_id}'; options: {list(DUMMY_ENVS)}")
-    return DUMMY_ENVS[env_id]()
+    return DUMMY_ENVS[env_id](**kwargs)
 
 
 def _wrapper_config(cfg: Any) -> Dict[str, Any]:
@@ -63,7 +63,12 @@ def _make_base_env(
 ) -> gym.Env:
     env_id = cfg.env.id
     if env_id in DUMMY_ENVS:
-        return get_dummy_env(env_id)
+        # wrapper kwargs pass through to the dummy constructors like every
+        # other suite (episode_len, random_start, grid, ...)
+        dummy_cfg = _wrapper_config(cfg)
+        return get_dummy_env(
+            env_id, **{k: v for k, v in dummy_cfg.items() if k not in ("kind", "id")}
+        )
     wrapper_cfg = _wrapper_config(cfg)
     kind = wrapper_cfg["kind"]
     if kind == "gym":
@@ -106,6 +111,15 @@ def _make_base_env(
 
         kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
         return SuperMarioBrosWrapper(env_id, render_mode=render_mode, **kwargs)
+    if kind == "jax":
+        # pure-JAX env behind the gymnasium API: every existing loop runs
+        # it unmodified; on-policy loops may bypass this path entirely and
+        # fuse the rollout on device (envs/jax/anakin.py)
+        from sheeprl_tpu.envs.jax.adapter import JaxToGymAdapter
+        from sheeprl_tpu.envs.jax.registry import make_jax_env
+
+        kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        return JaxToGymAdapter(make_jax_env(wrapper_cfg.get("id") or env_id, **kwargs))
     raise ValueError(f"Unknown env wrapper kind '{kind}'")
 
 

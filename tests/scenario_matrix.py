@@ -1,0 +1,311 @@
+#!/usr/bin/env python
+"""Scenario-matrix CI: every algo × {cpu-gym, jax-env, dummy} × {coupled,
+decoupled} dryrun grid with per-cell wall and compile budgets (ROADMAP item
+5 / ISSUE 11) — "as many scenarios as you can imagine" as an enforced gate
+instead of a slogan.
+
+Each cell is an end-to-end dryrun through ``sheeprl_tpu.cli.run`` on tiny
+shapes with ``algo.max_recompiles=1`` (the recompile detector is the
+compile budget: any program whose signature churns dies red) and a wall
+budget per cell (a wedged cell fails the grid; the run_ci stage timeout
+backstops a hang).  Cells a family cannot express are PRUNED with an
+explicit reason (e.g. sac_ae needs pixel obs — classic-control gym/jax
+envs have none), so the printed table documents the coverage honestly.
+
+Extra jax-env cells pin both rollout modes of the on-policy loops: Anakin
+fused (``algo.anakin=auto`` resolves on) AND the JaxToGymAdapter fallback
+(``algo.anakin=False``).
+
+Usage:
+  python tests/scenario_matrix.py              # full grid (run_ci stage)
+  python tests/scenario_matrix.py --filter ppo # substring-matched subset
+  SCENARIO_FILTER=jax python tests/scenario_matrix.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+from typing import List, Optional, Tuple
+
+# must precede any jax import (conftest-equivalent for a plain script)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# runnable as `python tests/scenario_matrix.py` without an install
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+COMMON = [
+    "dry_run=True",
+    "env.num_envs=2",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "fabric.devices=1",
+    "fabric.accelerator=cpu",
+    "metric.log_level=0",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "print_config=False",
+    "algo.run_test=False",
+    "algo.max_recompiles=1",  # the per-cell COMPILE budget
+]
+
+# tiny world-model sizing shared by the dreamer family (mirrors
+# tests/test_algos.TINY_WM_ARGS minus the obs-key choices, which are
+# per-family here)
+TINY_WM = [
+    "algo.per_rank_batch_size=2",
+    "algo.per_rank_sequence_length=8",
+    "algo.learning_starts=0",
+    "algo.horizon=4",
+    "algo.world_model.encoder.cnn_channels_multiplier=4",
+    "algo.dense_units=16",
+    "algo.mlp_layers=1",
+    "algo.world_model.recurrent_model.recurrent_state_size=16",
+    "algo.world_model.transition_model.hidden_size=16",
+    "algo.world_model.representation_model.hidden_size=16",
+    "buffer.size=400",
+]
+TINY_DV23 = ["algo.world_model.discrete_size=4", "algo.world_model.stochastic_size=4"]
+TINY_ONPOLICY = [
+    "algo.rollout_steps=8",
+    "algo.per_rank_batch_size=8",
+    "algo.mlp_keys.encoder=[state]",
+]
+TINY_SAC = [
+    "algo.per_rank_batch_size=8",
+    "algo.learning_starts=4",
+    "algo.mlp_keys.encoder=[state]",
+    "buffer.size=64",
+]
+
+# env-family fragments, keyed by the action-space class an algo needs
+FAMILY_ENVS = {
+    "dummy": {
+        "discrete": ["env=dummy", "env.id=discrete_dummy", "env.max_episode_steps=16"],
+        "continuous": ["env=dummy", "env.id=continuous_dummy", "env.max_episode_steps=16"],
+    },
+    "cpu_gym": {
+        "discrete": ["env=gym", "env.id=CartPole-v1", "env.sync_env=True"],
+        "continuous": ["env=gym", "env.id=Pendulum-v1", "env.sync_env=True"],
+    },
+    "jax": {
+        "discrete": ["env=jax_cartpole"],
+        "continuous": ["env=jax_pendulum"],
+    },
+}
+
+# obs-key fragments: dummy envs expose rgb+state; the classic-control
+# gym/jax envs are state-only
+KEYS_PIXEL_STATE = ["algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]"]
+KEYS_STATE_ONLY = ["algo.cnn_keys.encoder=[]", "algo.mlp_keys.encoder=[state]"]
+
+Cell = Tuple[str, Optional[List[str]], str, float]  # (name, overrides|None, skip_reason, budget_s)
+
+
+def _dreamer(exp: str, family: str, extra: List[str], space: str = "discrete", budget: float = 360.0) -> Cell:
+    env = FAMILY_ENVS[family][space]
+    keys = KEYS_PIXEL_STATE if family == "dummy" else KEYS_STATE_ONLY
+    return (
+        f"{exp}×{family}×coupled",
+        [f"exp={exp}", *env, *keys, *TINY_WM, *extra],
+        "",
+        budget,
+    )
+
+
+def build_cells() -> List[Cell]:
+    cells: List[Cell] = []
+    families = ("dummy", "cpu_gym", "jax")
+
+    # ---- on-policy (coupled): the jax column exercises ANAKIN fusion ----
+    for exp in ("ppo", "a2c", "ppo_recurrent"):
+        extra = ["algo.update_epochs=1"] if exp == "ppo" else []
+        if exp == "ppo_recurrent":
+            extra = ["algo.update_epochs=1", "algo.per_rank_sequence_length=4"]
+        for fam in families:
+            fam_extra = list(extra)
+            if exp == "ppo_recurrent" and fam != "cpu_gym":
+                # the exp config masks CartPole velocities; the masking
+                # wrapper only knows the gym classic-control layouts
+                fam_extra.append("env.mask_velocities=False")
+            cells.append(
+                (
+                    f"{exp}×{fam}×coupled",
+                    [f"exp={exp}", *FAMILY_ENVS[fam]["discrete"], *TINY_ONPOLICY, *fam_extra],
+                    "",
+                    240.0,
+                )
+            )
+    # both rollout modes of the fused loops are load-bearing: pin the
+    # adapter fallback and the pixel (CNN) fused path explicitly
+    cells.append(
+        (
+            "ppo×jax×coupled-adapter",
+            ["exp=ppo", *FAMILY_ENVS["jax"]["discrete"], *TINY_ONPOLICY,
+             "algo.update_epochs=1", "algo.anakin=False"],
+            "",
+            240.0,
+        )
+    )
+    cells.append(
+        (
+            "ppo×jax_forage×coupled-anakin-cnn",
+            ["exp=ppo", "env=jax_forage", "algo.rollout_steps=4",
+             "algo.per_rank_batch_size=8", "algo.update_epochs=1",
+             "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[]"],
+            "",
+            300.0,
+        )
+    )
+
+    # ---- off-policy (coupled) ----
+    for fam in families:
+        cells.append(
+            (
+                f"sac×{fam}×coupled",
+                ["exp=sac", *FAMILY_ENVS[fam]["continuous"], *TINY_SAC],
+                "",
+                240.0,
+            )
+        )
+        cells.append(
+            (
+                f"droq×{fam}×coupled",
+                ["exp=droq", *FAMILY_ENVS[fam]["continuous"], *TINY_SAC],
+                "",
+                240.0,
+            )
+        )
+        if fam == "dummy":
+            cells.append(
+                (
+                    f"sac_ae×{fam}×coupled",
+                    ["exp=sac_ae", *FAMILY_ENVS[fam]["continuous"],
+                     "algo.per_rank_batch_size=4", "algo.learning_starts=4",
+                     "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
+                     "algo.cnn_channels_multiplier=4", "algo.hidden_size=32",
+                     "algo.encoder.features_dim=16", "env.screen_size=32",
+                     "buffer.size=64"],
+                    "",
+                    300.0,
+                )
+            )
+        else:
+            cells.append(
+                (f"sac_ae×{fam}×coupled", None,
+                 "sac_ae needs pixel obs; classic-control gym/jax envs are state-only", 0.0)
+            )
+
+    # ---- dreamer family (coupled) ----
+    for fam in families:
+        cells.append(_dreamer("dreamer_v1", fam, ["algo.world_model.stochastic_size=8"], space="continuous"))
+        cells.append(_dreamer("dreamer_v2", fam, TINY_DV23))
+        cells.append(_dreamer("dreamer_v3", fam, TINY_DV23))
+        cells.append(
+            _dreamer("p2e_dv3_exploration", fam, [*TINY_DV23, "algo.ensembles.n=2"], budget=420.0)
+        )
+        # p2e_dv1/dv2 exploration share the dv1/dv2 world-model stacks the
+        # rows above already drive per family; finetuning variants need an
+        # exploration checkpoint and cannot dryrun standalone
+        for exp in ("p2e_dv1_exploration", "p2e_dv2_exploration"):
+            if fam == "dummy":
+                extra = ["algo.ensembles.n=2", "algo.per_rank_pretrain_steps=0"]
+                extra += TINY_DV23 if exp.endswith("dv2_exploration") else ["algo.world_model.stochastic_size=8"]
+                cells.append(_dreamer(exp, fam, extra, space="continuous", budget=420.0))
+            else:
+                cells.append(
+                    (f"{exp}×{fam}×coupled", None,
+                     "world-model stack covered by the dv1/dv2 rows; one ensemble cell per algo", 0.0)
+                )
+    for fam in families:
+        for exp in ("p2e_dv1_finetuning", "p2e_dv2_finetuning", "p2e_dv3_finetuning"):
+            cells.append(
+                (f"{exp}×{fam}×coupled", None,
+                 "finetuning resumes an exploration checkpoint; no standalone dryrun", 0.0)
+            )
+
+    # ---- decoupled topologies ----
+    for fam in families:
+        cells.append(
+            (
+                f"ppo_decoupled×{fam}×decoupled",
+                ["exp=ppo_decoupled", *FAMILY_ENVS[fam]["discrete"], *TINY_ONPOLICY,
+                 "algo.update_epochs=1"],
+                "",
+                300.0,
+            )
+        )
+        cells.append(
+            (
+                f"sac_decoupled×{fam}×decoupled",
+                ["exp=sac_decoupled", *FAMILY_ENVS[fam]["continuous"], *TINY_SAC],
+                "",
+                300.0,
+            )
+        )
+    return cells
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--filter", default=os.environ.get("SCENARIO_FILTER", ""),
+                        help="substring filter on cell names")
+    parser.add_argument("--list", action="store_true", help="print the grid and exit")
+    args = parser.parse_args()
+
+    cells = build_cells()
+    if args.filter:
+        cells = [c for c in cells if args.filter in c[0]]
+    if args.list:
+        for name, overrides, reason, budget in cells:
+            print(f"{name:48s} {'SKIP: ' + reason if overrides is None else f'budget {budget:.0f}s'}")
+        return 0
+
+    from sheeprl_tpu.utils.utils import force_cpu_backend
+
+    force_cpu_backend()
+    from sheeprl_tpu.cli import run
+
+    results = []
+    failures = []
+    logroot = os.environ.get("SCENARIO_LOG_DIR", "/tmp/scenario_matrix")
+    for idx, (name, overrides, reason, budget) in enumerate(cells):
+        if overrides is None:
+            results.append((name, "skip", 0.0, reason))
+            continue
+        t0 = time.perf_counter()
+        try:
+            run([*overrides, *COMMON, f"log_dir={logroot}/{idx}"])
+            wall = time.perf_counter() - t0
+            if wall > budget:
+                results.append((name, "OVER-BUDGET", wall, f"> {budget:.0f}s"))
+                failures.append(name)
+            else:
+                results.append((name, "ok", wall, ""))
+        except Exception:
+            wall = time.perf_counter() - t0
+            results.append((name, "FAIL", wall, traceback.format_exc(limit=3).splitlines()[-1]))
+            failures.append(name)
+            traceback.print_exc()
+
+    ran = sum(1 for r in results if r[1] == "ok")
+    skipped = sum(1 for r in results if r[1] == "skip")
+    print("\n=== scenario matrix ===")
+    for name, status, wall, note in results:
+        line = f"{name:48s} {status:12s} {wall:7.1f}s"
+        if note:
+            line += f"  {note}"
+        print(line)
+    print(f"\n{ran} ok, {skipped} pruned, {len(failures)} failed of {len(results)} cells")
+    if failures:
+        print("FAILED cells:", ", ".join(failures))
+        return 1
+    print("scenario matrix: ALL GREEN")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,108 @@
+"""One env contract across the three families the scenario matrix drives:
+{dummy, cpu-gym, pure-JAX (adapter)} (ISSUE 11, satellite).
+
+Per family, the same three claims:
+
+* ``reset(seed=s)`` is reproducible (same seed → same first obs and same
+  fixed-action trajectory) and seed-distinct where the env has any
+  stochasticity to seed;
+* exactly one of terminated/truncated is ever set on an episode end;
+* through ``utils.env.vectorize`` (SAME_STEP autoreset) a finished episode
+  surfaces ``final_obs``/``final_info`` in vector infos.
+"""
+
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.utils.env import make_env, vectorize
+
+# (family, compose overrides, fixed action, max steps to see an episode end)
+FAMILIES = {
+    "dummy": (
+        ["env=dummy", "env.id=discrete_dummy",
+         "env.wrapper.episode_len=12", "env.wrapper.random_start=True"],
+        1,
+        40,
+    ),
+    "cpu_gym": (
+        ["env=gym", "env.id=CartPole-v1", "env.sync_env=True"],
+        1,
+        200,
+    ),
+    "jax": (
+        ["env=jax_cartpole"],
+        1,
+        200,
+    ),
+}
+
+
+def _cfg(overrides):
+    return compose(
+        [
+            "exp=ppo", "algo.mlp_keys.encoder=[state]", "env.num_envs=2",
+            "env.capture_video=False", *overrides,
+        ]
+    )
+
+
+@pytest.fixture(params=sorted(FAMILIES), ids=sorted(FAMILIES))
+def family(request):
+    return request.param
+
+
+def _rollout(env, seed, action, n=6):
+    obs, _ = env.reset(seed=seed)
+    traj = [obs["state"].copy()]
+    for _ in range(n):
+        obs, _, term, trunc, _ = env.step(action)
+        traj.append(obs["state"].copy())
+        if term or trunc:
+            break
+    return traj
+
+
+class TestSeededReset:
+    def test_same_seed_reproduces(self, family):
+        overrides, action, _ = FAMILIES[family]
+        env = make_env(_cfg(overrides), None, 0)()
+        t1 = _rollout(env, 123, action)
+        t2 = _rollout(env, 123, action)
+        assert len(t1) == len(t2)
+        for a, b in zip(t1, t2):
+            np.testing.assert_array_equal(a, b)
+        env.close()
+
+    def test_different_seeds_diverge(self, family):
+        overrides, action, _ = FAMILIES[family]
+        env = make_env(_cfg(overrides), None, 0)()
+        o1, _ = env.reset(seed=1)
+        o2, _ = env.reset(seed=2)
+        assert not np.array_equal(o1["state"], o2["state"])
+        env.close()
+
+
+class TestEpisodeEnd:
+    def test_flags_exclusive_and_final_obs_surfaced(self, family):
+        overrides, action, max_steps = FAMILIES[family]
+        cfg = _cfg(overrides)
+        envs = vectorize(cfg, [make_env(cfg, 5, 0, vector_env_idx=i) for i in range(2)])
+        obs, _ = envs.reset(seed=5)
+        saw_end = False
+        act = np.full(2, action, dtype=np.int64)
+        for _ in range(max_steps):
+            obs, rew, term, trunc, info = envs.step(act)
+            assert not (np.asarray(term) & np.asarray(trunc)).any()
+            done = np.asarray(term) | np.asarray(trunc)
+            if done.any():
+                saw_end = True
+                # SAME_STEP autoreset: real terminal obs in final_obs, the
+                # returned obs is already the reset obs
+                assert "final_obs" in info and "final_info" in info
+                for i in np.nonzero(done)[0]:
+                    assert info["final_obs"][i] is not None
+                    assert "state" in info["final_obs"][i]
+                break
+        envs.close()
+        assert saw_end

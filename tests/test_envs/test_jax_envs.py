@@ -1,0 +1,238 @@
+"""Pure-JAX env semantics (envs/jax/, ISSUE 11).
+
+Four claims, each a test family:
+
+* **Transition parity** — from identical explicit states and actions,
+  ``JaxCartPole``/``JaxPendulum`` reproduce gymnasium's next obs, reward
+  and termination within float tolerance.  (Seeded *reset draws* cannot
+  match: threefry vs PCG64 — parity is pinned at the transition level,
+  which is what the train data actually sees.)
+* **Auto-reset + truncation boundary** — SAME_STEP semantics: the step
+  that finishes an episode returns the reset obs, surfaces the true
+  terminal obs as ``final_obs``, resets the step counter, and sets
+  exactly one of terminated/truncated at the time-limit boundary.
+* **Procedural pixel world** — forage renders uint8 channel-last pixels
+  in-trace, pays reward on eating, terminates when all food is gone,
+  and reseeds placements procedurally per episode.
+* **Adapter** — ``JaxToGymAdapter`` honors the gymnasium seeding
+  contract and composes with the existing ``make_env``/``vectorize``
+  pipeline (``final_obs`` in vector infos).
+"""
+
+import numpy as np
+import pytest
+
+import gymnasium as gym
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.adapter import JaxToGymAdapter
+from sheeprl_tpu.envs.jax.cartpole import CartPoleState, JaxCartPole
+from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+from sheeprl_tpu.envs.jax.forage import JaxForage
+from sheeprl_tpu.envs.jax.pendulum import JaxPendulum, PendulumState
+from sheeprl_tpu.envs.jax.registry import JAX_ENVS, make_jax_env
+
+
+# --------------------------------------------------------------------------
+# transition parity vs gymnasium
+# --------------------------------------------------------------------------
+
+class TestTransitionParity:
+    def test_cartpole_matches_gymnasium(self):
+        je = JaxCartPole()
+        ge = gym.make("CartPole-v1").unwrapped
+        rng = np.random.default_rng(11)
+        step = jax.jit(je.step)
+        for _ in range(100):
+            s = rng.uniform(-0.2, 0.2, 4).astype(np.float32)
+            a = int(rng.integers(2))
+            ge.reset()
+            ge.state = tuple(s)
+            g_obs, g_rew, g_term, _, _ = ge.step(a)
+            st = CartPoleState(
+                x=jnp.float32(s[0]), x_dot=jnp.float32(s[1]),
+                theta=jnp.float32(s[2]), theta_dot=jnp.float32(s[3]),
+                t=jnp.int32(0), key=jax.random.PRNGKey(0),
+            )
+            _, j_obs, j_rew, j_term, j_trunc = step(st, jnp.int32(a))
+            np.testing.assert_allclose(g_obs, np.asarray(j_obs["state"]), atol=1e-5)
+            assert float(g_rew) == float(j_rew) == 1.0
+            assert bool(g_term) == bool(j_term)
+            assert not bool(j_trunc)
+
+    def test_cartpole_termination_thresholds(self):
+        je = JaxCartPole()
+        # drive the pole over the 12 degree threshold
+        st = CartPoleState(
+            x=jnp.float32(0.0), x_dot=jnp.float32(0.0),
+            theta=jnp.float32(0.2), theta_dot=jnp.float32(2.0),
+            t=jnp.int32(0), key=jax.random.PRNGKey(0),
+        )
+        _, _, _, term, _ = je.step(st, jnp.int32(1))
+        assert bool(term)
+
+    def test_pendulum_matches_gymnasium(self):
+        jp = JaxPendulum()
+        gp = gym.make("Pendulum-v1").unwrapped
+        rng = np.random.default_rng(12)
+        step = jax.jit(jp.step)
+        for _ in range(100):
+            th, thdot = rng.uniform(-np.pi, np.pi), rng.uniform(-8, 8)
+            u = rng.uniform(-2, 2, (1,)).astype(np.float32)
+            gp.reset()
+            gp.state = np.array([th, thdot])
+            g_obs, g_rew, g_term, _, _ = gp.step(u)
+            st = PendulumState(
+                theta=jnp.float32(th), theta_dot=jnp.float32(thdot),
+                t=jnp.int32(0), key=jax.random.PRNGKey(0),
+            )
+            _, j_obs, j_rew, j_term, _ = step(st, jnp.asarray(u))
+            np.testing.assert_allclose(g_obs, np.asarray(j_obs["state"]), atol=1e-4)
+            assert abs(float(g_rew) - float(j_rew)) < 1e-4
+            assert not bool(g_term) and not bool(j_term)
+
+    def test_reset_within_gymnasium_bounds(self):
+        # the draw distribution matches even though the PRNG cannot
+        states = [JaxCartPole().reset(jax.random.PRNGKey(i))[1]["state"] for i in range(20)]
+        arr = np.stack([np.asarray(s) for s in states])
+        assert np.all(np.abs(arr) <= 0.05)
+        p_obs = JaxPendulum().reset(jax.random.PRNGKey(0))[1]["state"]
+        assert np.abs(np.asarray(p_obs)[2]) <= 1.0  # theta_dot ~ U(-1, 1)
+
+
+# --------------------------------------------------------------------------
+# auto-reset + truncation boundary
+# --------------------------------------------------------------------------
+
+class TestAutoReset:
+    def test_same_step_autoreset_surfaces_final_obs(self):
+        venv = VectorJaxEnv(JaxCartPole(), 4)
+        state, obs = venv.reset(jax.random.PRNGKey(0))
+        step = jax.jit(venv.step)
+        # always-right eventually topples every pole
+        saw_done = False
+        for _ in range(60):
+            prev_t = np.asarray(state.t)
+            state, obs, rew, term, trunc, final_obs = step(state, jnp.ones((4,), jnp.int32))
+            done = np.asarray(term) | np.asarray(trunc)
+            t = np.asarray(state.t)
+            if done.any():
+                saw_done = True
+                # finished rows restarted (SAME_STEP): counter back to 0,
+                # returned obs is the RESET obs (within reset bounds), the
+                # true terminal obs preserved in final_obs
+                assert (t[done] == 0).all()
+                assert (np.abs(np.asarray(obs["state"])[done]) <= 0.05).all()
+                assert (np.abs(np.asarray(final_obs["state"])[done]) > 0.05).any()
+            assert (t[~done] == prev_t[~done] + 1).all()
+        assert saw_done
+
+    def test_truncation_boundary_flags(self):
+        # a pendulum never terminates: at the limit it must truncate, once
+        venv = VectorJaxEnv(JaxPendulum(max_episode_steps=7), 2)
+        state, _ = venv.reset(jax.random.PRNGKey(3))
+        acts = jnp.zeros((2, 1), jnp.float32)
+        for i in range(1, 15):
+            state, obs, rew, term, trunc, final_obs = venv.step(state, acts)
+            assert not np.asarray(term).any()
+            expect_trunc = i % 7 == 0
+            assert np.asarray(trunc).all() == expect_trunc
+            assert np.asarray(trunc).any() == expect_trunc
+
+    def test_terminated_and_truncated_never_both(self):
+        venv = VectorJaxEnv(JaxCartPole(max_episode_steps=5), 8)
+        state, _ = venv.reset(jax.random.PRNGKey(4))
+        for _ in range(40):
+            state, _, _, term, trunc, _ = venv.step(state, jnp.ones((8,), jnp.int32))
+            assert not (np.asarray(term) & np.asarray(trunc)).any()
+
+    def test_instances_decorrelate(self):
+        # per-instance PRNG keys: vector reset must not clone one episode
+        venv = VectorJaxEnv(JaxCartPole(), 8)
+        _, obs = venv.reset(jax.random.PRNGKey(5))
+        assert len(np.unique(np.asarray(obs["state"])[:, 0])) > 1
+
+
+# --------------------------------------------------------------------------
+# procedural pixel world
+# --------------------------------------------------------------------------
+
+class TestForage:
+    def test_pixel_contract(self):
+        env = JaxForage(grid=4, n_food=3, image_hw=64)
+        state, obs = env.reset(jax.random.PRNGKey(0))
+        assert obs["rgb"].shape == (64, 64, 3) and obs["rgb"].dtype == jnp.uint8
+        img = np.asarray(obs["rgb"])
+        # agent painted white, food green, exactly as placed
+        assert (img == 255).all(axis=-1).sum() == 16 * 16  # one white cell
+        assert int(np.asarray(state.food).sum()) == 3
+
+    def test_eating_pays_and_terminates(self):
+        env = JaxForage(grid=2, n_food=1, image_hw=8, max_episode_steps=50)
+        state, _ = env.reset(jax.random.PRNGKey(1))
+        # walk the 2x2 grid until the single food is eaten
+        total = 0.0
+        term = False
+        for a in [1, 3, 2, 4, 1, 3]:
+            state, _, rew, term, trunc, = env.step(state, jnp.int32(a))
+            total += float(rew)
+            if bool(term):
+                break
+        assert term and total == 1.0
+        # no food left on the grid
+        assert int(np.asarray(state.food).sum()) == 0
+
+    def test_procedural_reset_reseeds_placement(self):
+        env = JaxForage()
+        _, o1 = env.reset(jax.random.PRNGKey(1))
+        _, o2 = env.reset(jax.random.PRNGKey(2))
+        _, o1b = env.reset(jax.random.PRNGKey(1))
+        assert not np.array_equal(np.asarray(o1["rgb"]), np.asarray(o2["rgb"]))
+        assert np.array_equal(np.asarray(o1["rgb"]), np.asarray(o1b["rgb"]))
+
+
+# --------------------------------------------------------------------------
+# registry + adapter
+# --------------------------------------------------------------------------
+
+class TestRegistryAdapter:
+    def test_registry_names(self):
+        assert {"cartpole", "pendulum", "forage"} <= set(JAX_ENVS)
+        assert isinstance(make_jax_env("jax_cartpole"), JaxCartPole)
+        with pytest.raises(ValueError, match="Unknown jax env"):
+            make_jax_env("jax_nope")
+
+    def test_adapter_seeding_contract(self):
+        ad = JaxToGymAdapter(make_jax_env("cartpole"))
+        o1, _ = ad.reset(seed=9)
+        t1 = [ad.step(1)[0]["state"] for _ in range(5)]
+        o2, _ = ad.reset(seed=9)
+        t2 = [ad.step(1)[0]["state"] for _ in range(5)]
+        np.testing.assert_array_equal(o1["state"], o2["state"])
+        for a, b in zip(t1, t2):
+            np.testing.assert_array_equal(a, b)
+        o3, _ = ad.reset(seed=10)
+        assert not np.array_equal(o1["state"], o3["state"])
+
+    def test_adapter_through_make_env_vectorize(self):
+        from sheeprl_tpu.config.compose import compose
+        from sheeprl_tpu.utils.env import make_env, vectorize
+
+        cfg = compose(
+            [
+                "exp=ppo", "env=jax_cartpole", "env.num_envs=2",
+                "algo.mlp_keys.encoder=[state]", "env.capture_video=False",
+            ]
+        )
+        envs = vectorize(cfg, [make_env(cfg, 7, 0, vector_env_idx=i) for i in range(2)])
+        obs, _ = envs.reset(seed=7)
+        assert obs["state"].shape == (2, 4)
+        saw_final = False
+        for _ in range(600):
+            obs, rew, term, trunc, info = envs.step(np.ones(2, dtype=np.int64))
+            if "final_obs" in info:
+                saw_final = True
+                break
+        envs.close()
+        assert saw_final

@@ -197,3 +197,27 @@ def test_spec_from_config_forms():
     assert shd.spec_from_config("model") == P("model")
     assert shd.spec_from_config([None, "model"]) == P(None, "model")
     assert shd.spec_from_config([["data", "model"], None]) == P(("data", "model"), None)
+
+
+def test_env_state_partition_spec():
+    # Anakin env-state placement (envs/jax/anakin.py): leading env axis
+    # shards over `data` when divisible, replicates otherwise
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": 2, "model": 4})
+    assert shd.env_state_partition_spec(4, fab.mesh) == P("data")
+    assert shd.env_state_partition_spec(3, fab.mesh) == P()
+    assert shd.env_state_partition_spec(4, None) == P()
+
+
+def test_anakin_actor_state_sharded_over_data():
+    import jax
+    from sheeprl_tpu.envs.jax.anakin import init_actor_state
+    from sheeprl_tpu.envs.jax.cartpole import JaxCartPole
+    from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+
+    fab = Fabric(devices=8, accelerator="cpu", mesh_shape={"data": 2, "model": 4})
+    venv = VectorJaxEnv(JaxCartPole(), 4)
+    actor = init_actor_state(fab, venv, jax.random.PRNGKey(0), 0, sharded=True)
+    assert actor["env"].x.sharding.spec == P("data")
+    assert actor["ep_ret"].sharding.spec == P("data")
+    # the update counter replicates (it is a scalar shared by every shard)
+    assert actor["update"].sharding.spec == P()

@@ -121,35 +121,53 @@ class TestRatio:
             Ratio(1.0, pretrain_steps=-1)
 
 
-class TestWindowChunks:
-    """utils.window_chunks: burst update windows are split under a device
-    byte budget so the first post-learning_starts dispatch can't exceed HBM
-    (the r5 TPU learning capture OOMed on a single 25.8 GiB padded block)."""
+class TestUpdateChunks:
+    """data/device_replay.update_chunks: burst update windows split into
+    power-of-two dispatch chunks for compile reuse, with the on-device
+    gathered-block HBM cap honored when per-update bytes are known (the
+    r5 TPU learning capture OOMed on a single 25.8 GiB padded block).
+    (Migrated off the deprecated ``utils.window_chunks`` byte-probe shim —
+    ISSUE 11 satellite; one shim-compat test remains below.)"""
 
     def test_steady_state_single_chunk(self):
-        from sheeprl_tpu.utils.utils import window_chunks
+        from sheeprl_tpu.data.device_replay import update_chunks
 
-        assert window_chunks(1, 1e6) == [1]
-        assert window_chunks(4, 1e6) == [4]
+        assert update_chunks(1) == [1]
+        assert update_chunks(4) == [4]
 
     def test_burst_split_and_total_preserved(self):
-        from sheeprl_tpu.utils.utils import window_chunks
+        from sheeprl_tpu.data.device_replay import update_chunks
 
-        # DV3-S walker-walk shape: ~12.6 MB/update, 1 GiB budget -> <=85/chunk,
-        # power-of-two sizes (compile reuse: each distinct U compiles once)
-        chunks = window_chunks(1026, 12.6e6)
+        # DV3-S pixel shape: ~12.6 MB gathered per update, 2 GiB HBM cap
+        # -> power-of-two sizes (compile reuse: each distinct U compiles once)
+        chunks = update_chunks(1026, bytes_per_update=12.6e6)
         assert sum(chunks) == 1026
-        assert max(chunks) * 12.6e6 <= 2**30
+        assert max(chunks) * 12.6e6 <= 2**31
         assert all(c & (c - 1) == 0 for c in chunks)  # powers of two
         assert len(set(chunks)) <= 3  # few distinct compiled shapes
 
-    def test_budget_env_override(self, monkeypatch):
-        from sheeprl_tpu.utils.utils import window_chunks
+    def test_cap_env_override(self, monkeypatch):
+        from sheeprl_tpu.data.device_replay import update_chunks
 
-        monkeypatch.setenv("SHEEPRL_MAX_WINDOW_BYTES", "100")
-        assert window_chunks(10, 50.0) == [2, 2, 2, 2, 2]
+        monkeypatch.setenv("SHEEPRL_MAX_WINDOW_UPDATES", "2")
+        assert update_chunks(10) == [2, 2, 2, 2, 2]
+
+    def test_hbm_budget_env_override(self, monkeypatch):
+        from sheeprl_tpu.data.device_replay import update_chunks
+
+        monkeypatch.setenv("SHEEPRL_MAX_HBM_WINDOW_BYTES", "100")
+        assert update_chunks(10, bytes_per_update=50.0) == [2, 2, 2, 2, 2]
 
     def test_huge_per_update_never_zero(self):
+        from sheeprl_tpu.data.device_replay import update_chunks
+
+        assert update_chunks(3, bytes_per_update=1e12) == [1, 1, 1]
+
+    def test_window_chunks_shim_compat(self):
+        # the deprecated byte-probed spelling (external callers only)
+        # still decomposes under its own budget law
         from sheeprl_tpu.utils.utils import window_chunks
 
-        assert window_chunks(3, 1e12) == [1, 1, 1]
+        chunks = window_chunks(1026, 12.6e6)
+        assert sum(chunks) == 1026
+        assert all(c & (c - 1) == 0 for c in chunks)
