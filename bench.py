@@ -734,6 +734,132 @@ def bench_env() -> dict:
     }
 
 
+def bench_sebulba() -> dict:
+    """Sebulba actor–learner topology bench (``--mode sebulba``, ISSUE 12).
+
+    Two measured runs of decoupled PPO on jax CartPole:
+
+    * **adapter-path decoupled baseline** — the pipelined single-controller
+      ``ppo_decoupled`` stepping the jax env through ``JaxToGymAdapter``
+      (the pre-Sebulba dataflow);
+    * **sebulba** — the device-group split (``topology=sebulba``): fused
+      jax-env rollout shards on the actor devices, the learner sub-mesh
+      consuming the device-resident trajectory queue, learner→actor D2D
+      param broadcast, transfer guard ARMED over post-warmup actor windows.
+
+    Reports env_steps/s + learner updates/s + actor_idle_frac +
+    queue_depth_frac + staleness, and GATES the ISSUE 12 acceptance:
+    every actor executable holds ``cache_size() == 1`` across the
+    ``BENCH_SEBULBA_UPDATES`` (default 50) steady windows, and the
+    sebulba run beats the adapter-path baseline on env-steps/s.
+    """
+    # CPU hosts need fake devices for a real device split — must land in
+    # XLA_FLAGS before the backend initializes (no-op if already forced)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    import jax
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.sebulba.ppo import run_sebulba
+
+    n_devices = len(jax.devices())
+    n_actors = int(os.environ.get("BENCH_SEBULBA_ACTORS", max(1, n_devices // 2)))
+    n_envs = int(os.environ.get("BENCH_SEBULBA_ENVS", 16))
+    rollout_steps = int(os.environ.get("BENCH_SEBULBA_T", 16))
+    updates = int(os.environ.get("BENCH_SEBULBA_UPDATES", 50))
+    baseline_updates = int(os.environ.get("BENCH_SEBULBA_BASELINE_UPDATES", 8))
+
+    common = [
+        "exp=ppo_decoupled",
+        "env=jax_cartpole",
+        f"env.num_envs={n_envs}",
+        "env.capture_video=False",
+        "fabric.accelerator=auto",
+        f"fabric.devices={n_devices}",
+        f"algo.rollout_steps={rollout_steps}",
+        f"algo.per_rank_batch_size={n_envs * rollout_steps}",
+        "algo.update_epochs=1",
+        "algo.cnn_keys.encoder=[]",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.max_recompiles=1",
+        "algo.run_test=False",
+        "checkpoint.every=0",
+        "checkpoint.save_last=False",
+        "buffer.memmap=False",
+        "metric.log_level=0",
+        "print_config=False",
+    ]
+
+    # ---- adapter-path decoupled baseline (pipelined topology) -------------
+    from sheeprl_tpu.algos.ppo.ppo_decoupled import main as ppo_decoupled_main
+
+    base_steps = n_envs * rollout_steps * baseline_updates
+    cfg = compose(common + [
+        f"algo.total_steps={base_steps}",
+        "log_dir=/tmp/bench_sebulba_baseline",
+    ])
+    fabric = build_fabric(cfg)
+    t0 = time.perf_counter()
+    ppo_decoupled_main(fabric, cfg)
+    baseline_wall = time.perf_counter() - t0
+    baseline_rate = base_steps / baseline_wall
+
+    # ---- sebulba device split ---------------------------------------------
+    seb_steps = n_envs * rollout_steps * updates
+    cfg = compose(common + [
+        "topology=sebulba",
+        f"topology.actor_devices={n_actors}",
+        f"algo.total_steps={seb_steps}",
+        "buffer.transfer_guard=True",  # actor steady windows run guarded
+        "log_dir=/tmp/bench_sebulba_run",
+    ])
+    fabric = build_fabric(cfg)
+    stats = run_sebulba(fabric, cfg)
+
+    cache_ok = all(
+        all(size == 1 for size in sizes.values()) for sizes in stats["actor_cache_sizes"]
+    )
+    beats = stats["env_steps_per_s"] > baseline_rate
+    dev = jax.devices()[0]
+    return {
+        "metric": (
+            f"sebulba_env_steps_per_s (ppo_decoupled jax-cartpole x{n_envs}, "
+            f"{n_actors} actor + {max(n_devices - n_actors, 1)} learner devices, "
+            f"{updates} windows, {dev.platform})"
+        ),
+        "value": round(stats["env_steps_per_s"], 1),
+        "unit": "env_steps/s",
+        # the acceptance comparison: sebulba jax-env actors vs the
+        # adapter-path pipelined decoupled baseline on this host
+        "vs_baseline": round(stats["env_steps_per_s"] / baseline_rate, 2),
+        "env_steps_per_s": round(stats["env_steps_per_s"], 1),
+        "env_steps_per_s_adapter_baseline": round(baseline_rate, 1),
+        "updates_per_s": round(stats["updates_per_s"], 3),
+        "actor_idle_frac": round(stats["actor_idle_frac"], 4),
+        "queue_depth_frac": round(stats["queue_depth_frac"], 4),
+        "param_staleness_max": stats["param_staleness_max"],
+        "traj_staleness_max": stats["traj_staleness_max"],
+        "traj_staleness_avg": round(stats["traj_staleness_avg"], 3),
+        "actor_cache_sizes": stats["actor_cache_sizes"],
+        "steady_windows": updates,
+        "actor_devices": n_actors,
+        "learner_devices": n_devices - n_actors if n_devices > 1 else 1,
+        "worker_restarts": stats["worker_restarts"],
+        "torn_rejected": stats["torn_rejected"],
+        # ISSUE 12 acceptance gates: compile-once actor inference across the
+        # steady windows under the armed guard, and beating the adapter path
+        "cache_size_one": cache_ok,
+        "beats_adapter_baseline": beats,
+        "gate_failed": not (cache_ok and beats),
+    }
+
+
 def bench_fault_overhead() -> dict:
     """Zero-overhead gate for the fault-injection layer (docs/resilience.md).
 
@@ -868,6 +994,8 @@ def _run_bench() -> dict:
         return bench_fault_overhead()
     if target == "env":
         return bench_env()
+    if target == "sebulba":
+        return bench_sebulba()
     if target in BASELINE_CPU_WALL_CLOCK_S:
         return bench_cpu_wall_clock(target)
     return bench_dreamer_v3()

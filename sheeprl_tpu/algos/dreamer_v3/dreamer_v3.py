@@ -594,7 +594,11 @@ def dreamer_family_loop(
                 aggregator.update("State/prior_entropy", pre)
             last_log = flush_metrics(
                 aggregator, timer, logger, policy_step, last_log,
-                extra_metrics={"Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1)},
+                extra_metrics={
+                    "Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1),
+                    # deferred-sync staleness, made visible (ISSUE 12)
+                    **psync.metrics(),
+                },
             )
 
         # ---------------- checkpoint ------------------------------------------

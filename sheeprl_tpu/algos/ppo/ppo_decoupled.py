@@ -151,6 +151,7 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
         )
         return p, o_state, jax.tree.map(lambda x: x[-1], losses)
 
+    train_phase_raw = train_phase  # the sebulba learner fuses it (concat + GAE + epochs)
     train_phase = compile_once(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
@@ -159,7 +160,7 @@ def _build_train_fns(agent, optimizer, cfg, obs_keys, actions_dim, is_continuous
         max_recompiles=cfg.algo.get("max_recompiles"),
     )
 
-    return policy_step_fn, values_fn, train_phase
+    return policy_step_fn, values_fn, train_phase, train_phase_raw
 
 
 def _run_rollout(ctx, obs, p_params, key, fold_rank=None):
@@ -232,15 +233,36 @@ def main(fabric: Any, cfg: Any) -> None:
             "already collects ONE global rollout that every trainer minibatches "
             "(reference: sheeprl/algos/ppo/ppo_decoupled.py:639-643)"
         )
+    from sheeprl_tpu.parallel.topology import resolve_topology
+
+    if resolve_topology(cfg, fabric) == "sebulba":
+        # the Sebulba actor/learner device split (docs/sebulba.md)
+        from sheeprl_tpu.sebulba.ppo import run_sebulba
+
+        run_sebulba(fabric, cfg)
+        return
     dedicated = (cfg.algo.get("player", {}) or {}).get("dedicated", False)
     if dedicated and fabric.num_processes > 1:
+        # DEPRECATION SHIM: the two-rank (dedicated player process) split is
+        # superseded by the single-controller Sebulba device split, which
+        # keeps the overlap without shipping rollouts over host collectives
+        import warnings
+
+        warnings.warn(
+            "algo.player.dedicated=True (the two-rank player/trainer split) "
+            "is deprecated: use the Sebulba device split instead "
+            "(topology=sebulba topology.actor_devices=K, docs/sebulba.md). "
+            "The cross-process path still runs for now.",
+            DeprecationWarning,
+        )
         return _dedicated_main(fabric, cfg)
     if dedicated:
         import warnings
 
         warnings.warn(
             "algo.player.dedicated=True needs >= 2 processes (jax.distributed); "
-            "falling back to the single-controller pipelined topology",
+            "falling back to the single-controller pipelined topology "
+            "(deprecated — prefer topology=sebulba, docs/sebulba.md)",
             UserWarning,
         )
     rank = fabric.global_rank
@@ -289,7 +311,7 @@ def main(fabric: Any, cfg: Any) -> None:
     # current weights)
     host = fabric.player_device(cfg)
     gamma = float(cfg.algo.gamma)
-    policy_step_fn, values_fn, train_phase = _build_train_fns(
+    policy_step_fn, values_fn, train_phase, _ = _build_train_fns(
         agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type
     )
 
@@ -519,7 +541,7 @@ def _dedicated_main(fabric: Any, cfg: Any) -> None:
         )
         opt_state = trainer_fabric.replicate(state.get("opt_state") or optimizer.init(params))
 
-    policy_step_fn, values_fn, train_phase = _build_train_fns(
+    policy_step_fn, values_fn, train_phase, _ = _build_train_fns(
         agent, optimizer, cfg, obs_keys, actions_dim, is_continuous, dist_type
     )
 

@@ -97,15 +97,29 @@ def main(fabric: Any, cfg: Any) -> None:
         save_configs(cfg, log_dir)
 
     num_envs = cfg.env.num_envs
-    envs = vectorize(
-        cfg,
-        [
-            make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
-            for i in range(num_envs)
-        ],
-    )
-    obs_space = envs.single_observation_space
-    act_space = envs.single_action_space
+    from sheeprl_tpu.envs.jax.registry import anakin_enabled
+
+    use_anakin = anakin_enabled(cfg, fabric)
+    if use_anakin:
+        # Anakin mode (envs/jax/anakin.py): the env lives INSIDE the
+        # compiled update — no vector-env processes exist at all
+        from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+        from sheeprl_tpu.envs.jax.registry import jax_env_from_cfg
+
+        envs = None
+        venv = VectorJaxEnv(jax_env_from_cfg(cfg), num_envs)
+        obs_space = venv.single_observation_space
+        act_space = venv.single_action_space
+    else:
+        envs = vectorize(
+            cfg,
+            [
+                make_env(cfg, cfg.seed + rank * num_envs + i, rank, run_name=log_dir, vector_env_idx=i)
+                for i in range(num_envs)
+            ],
+        )
+        obs_space = envs.single_observation_space
+        act_space = envs.single_action_space
     normalize_obs_keys(cfg, obs_space)
     actions_dim, is_continuous = spaces_to_dims(act_space)
     mlp_keys = tuple(cfg.algo.mlp_keys.encoder)
@@ -225,6 +239,7 @@ def main(fabric: Any, cfg: Any) -> None:
 
     # the staged rollout is donated too (argnum 2): one dispatch consumes it
     # exactly once (see ppo.py)
+    train_phase_fn = train_phase  # raw callable: the Anakin path fuses it
     train_phase = fabric.compile(
         train_phase,
         name=f"{cfg.algo.name}.train_phase",
@@ -246,17 +261,19 @@ def main(fabric: Any, cfg: Any) -> None:
     last_log = int(state.get("last_log", 0))
     last_checkpoint = int(state.get("last_checkpoint", 0))
 
-    rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=mlp_keys)
+    rb = ReplayBuffer(rollout_steps, num_envs, memmap=False, obs_keys=mlp_keys) if not use_anakin else None
 
-    # rank-offset: each process's envs must be distinct streams or
-    # multi-host DP collects the same data num_processes times
-    obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
-    prev_actions = np.zeros((num_envs, act_width), np.float32)
-    is_first = np.ones((num_envs, 1), np.float32)
-    carry_np = (
-        np.zeros((num_envs, cfg.algo.rnn.lstm.hidden_size), np.float32),
-        np.zeros((num_envs, cfg.algo.rnn.lstm.hidden_size), np.float32),
-    )
+    hidden_size = int(cfg.algo.rnn.lstm.hidden_size)
+    if not use_anakin:
+        # rank-offset: each process's envs must be distinct streams or
+        # multi-host DP collects the same data num_processes times
+        obs, _ = envs.reset(seed=cfg.seed + rank * num_envs)
+        prev_actions = np.zeros((num_envs, act_width), np.float32)
+        is_first = np.ones((num_envs, 1), np.float32)
+        carry_np = (
+            np.zeros((num_envs, hidden_size), np.float32),
+            np.zeros((num_envs, hidden_size), np.float32),
+        )
     player_params = fabric.to_host(params)
     last_losses = None
     # per-rank player key stream, advanced inside policy_step_fn; the main
@@ -282,126 +299,217 @@ def main(fabric: Any, cfg: Any) -> None:
     )
     num_minibatches = -(-global_envs // env_bs)
 
-    for update in range(start_iter, total_iters + 1):
-        init_carry = (carry_np[0].copy(), carry_np[1].copy())
-        with timer("Time/env_interaction_time"):
-            with jax.default_device(host):
-                for _ in range(rollout_steps):
-                    policy_step += num_envs * fabric.num_processes
-                    dev_obs = {
-                        k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
-                        for k in mlp_keys
-                    }
-                    carry, actions, logprobs, _, player_key = policy_step_fn(
-                        player_params,
-                        (jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
-                        dev_obs,
-                        jnp.asarray(prev_actions),
-                        jnp.asarray(is_first),
-                        player_key,
-                    )
-                    carry_np = (np.asarray(carry[0]), np.asarray(carry[1]))
-                    actions_np = np.asarray(actions)
-                    next_obs, rewards, terminated, truncated, info = envs.step(
-                        actions_for_env(actions_np, act_space)
-                    )
-                    dones = np.logical_or(terminated, truncated).astype(np.float32)
-                    rewards = np.asarray(rewards, np.float32)
+    # ---------------- Anakin fused rollout+train ----------------------------
+    if use_anakin:
+        from sheeprl_tpu.envs.jax.anakin import (
+            init_actor_state,
+            make_recurrent_rollout_fn,
+            traced_polynomial_decay,
+        )
 
-                    # truncation bootstrap (reference: ppo.py:287-306) using the
-                    # post-step recurrent state; padded to the full env batch
-                    if np.any(truncated):
-                        final_obs = final_obs_rows(info, np.nonzero(truncated)[0], mlp_keys)
-                        if final_obs is not None:
-                            padded = {
-                                k: np.asarray(next_obs[k], np.float32).reshape(num_envs, -1).copy()
-                                for k in mlp_keys
-                            }
-                            for k in mlp_keys:
-                                padded[k][truncated] = np.asarray(final_obs[k], np.float32).reshape(
-                                    int(truncated.sum()), -1
-                                )
-                            prev_a_boot = np.asarray(
-                                one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
-                            )
-                            _, (_, v_boot) = agent.apply(
-                                player_params, method=RecurrentPPOAgent.step,
-                                carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
-                                obs={k: jnp.asarray(padded[k]) for k in mlp_keys},
-                                prev_actions=jnp.asarray(prev_a_boot),
-                                is_first=jnp.zeros((num_envs, 1)),
-                            )
-                            v_boot = np.asarray(v_boot)[..., 0]
-                            rewards[truncated] += gamma * v_boot[truncated]
-
-                    step = {
-                        "actions": actions_np[None],
-                        "logprobs": np.asarray(logprobs)[None],
-                        "rewards": rewards[None],
-                        "dones": dones[None],
-                        "is_first": is_first[None, :, 0],
-                        "prev_actions": prev_actions[None],
-                    }
-                    for k in mlp_keys:
-                        step[k] = np.asarray(obs[k], np.float32).reshape(1, num_envs, -1)
-                    rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step.items()})
-
-                    obs = next_obs
-                    prev_actions = np.array(
-                        one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
-                    )
-                    prev_actions[dones.astype(bool)] = 0.0
-                    is_first = dones[:, None]
-                    for ep_ret, ep_len in episode_stats(info):
-                        aggregator.update("Rewards/rew_avg", ep_ret)
-                        aggregator.update("Game/ep_len_avg", ep_len)
-
-        with timer("Time/train_time"):
-            # donated device staging: host-numpy layout + EXPLICIT device_puts
-            # (data/device_replay.stage_rollout), rollout donated into the
-            # one-dispatch update (see ppo.py)
-            local = rb.buffer
-            host_rollout = {k: np.asarray(local[k], np.float32) for k in mlp_keys}
-            host_rollout["actions"] = np.asarray(local["actions"])
-            host_rollout["prev_actions"] = np.asarray(local["prev_actions"])
-            host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
-            host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
-            host_rollout["dones"] = np.asarray(local["dones"][..., 0])
-            host_rollout["is_first"] = np.asarray(local["is_first"])  # (T, B, 1)
-            # single-process: replicate (the env-axis minibatch gathers are
-            # cheapest on replicated data); multi-host: each process only has
-            # its own env rows, so assemble the global env axis instead
-            rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
-
-            # bootstrap values for the state after the rollout
-            dev_obs = {
-                k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1)) for k in mlp_keys
-            }
-            _, (_, last_v) = agent.apply(
-                player_params, method=RecurrentPPOAgent.step,
-                carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
-                obs=dev_obs, prev_actions=jnp.asarray(prev_actions),
-                is_first=jnp.asarray(is_first),
+        def step_apply(p, carry, obs_d, prev_a, first):
+            return agent.apply(
+                p, method=RecurrentPPOAgent.step, carry=carry, obs=obs_d,
+                prev_actions=prev_a, is_first=first,
             )
-            key, tk = jax.random.split(key)
-            carry_pair = (np.asarray(init_carry[0]), np.asarray(init_carry[1]))
-            last_v_flat = np.asarray(last_v)[..., 0]
-            ent_dev = stage_scalar(ent_coef_v)
-            with steady_guard(guard_on and update > start_iter):
-                params, opt_state, last_losses = train_phase(
-                    params, opt_state, rollout,
-                    fabric.shard_batch(carry_pair, axis=0) if sharded_envs else fabric.replicate(carry_pair),
-                    fabric.shard_batch(last_v_flat, axis=0) if sharded_envs else fabric.replicate(last_v_flat),
-                    tk, ent_dev, env_bs=env_bs, num_minibatches=num_minibatches,
-                )
-            player_params = fabric.to_host(params)
 
-        if cfg.algo.anneal_lr:
+        def _sample_fn(actor_out, k):
+            return _sample(actor_out, actions_dim, is_continuous, k)
+
+        def _encode(a):
+            return one_hot_actions(a, actions_dim, is_continuous)
+
+        rollout_fn = make_recurrent_rollout_fn(
+            venv, step_apply, _sample_fn, _encode,
+            mlp_keys=mlp_keys, action_space=act_space, gamma=gamma,
+            rollout_steps=rollout_steps,
+        )
+
+        def anakin_phase(p, o_state, actor, k):
+            """``nn.scan``-policy rollout + forward scan + GAE + epochs in
+            ONE device program, schedules computed in-trace from the
+            donated update counter (zero H2D in steady state — the
+            ppo/a2c Anakin gates, ROADMAP item 5)."""
+            k_roll, k_train, k_next = jax.random.split(k, 3)
+            step0 = actor["update"]
+            ent = (
+                traced_polynomial_decay(step0, initial=initial_ent_coef, max_decay_steps=total_iters)
+                if cfg.algo.anneal_ent_coef
+                else jnp.float32(initial_ent_coef)
+            )
+            if cfg.algo.anneal_lr:
+                o_state = set_learning_rate(
+                    o_state,
+                    traced_polynomial_decay(step0, initial=base_lr, max_decay_steps=total_iters),
+                )
+            actor, rollout, init_carry, last_values, stats = rollout_fn(p, actor, k_roll)
+            p, o_state, losses = train_phase_fn(
+                p, o_state, rollout, init_carry, last_values, k_train, ent,
+                env_bs=env_bs, num_minibatches=num_minibatches,
+            )
+            return p, o_state, actor, k_next, losses, stats
+
+        anakin_step = fabric.compile(
+            anakin_phase,
+            name=f"{cfg.algo.name}.anakin_phase",
+            donate_argnums=(0, 1, 2),
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
+        actor_state = init_actor_state(
+            fabric, venv, jax.random.fold_in(key, fabric.global_rank + 1),
+            start_iter - 1,
+            sharded=num_envs % fabric.local_world_size == 0,
+            extra={
+                "carry": (
+                    jnp.zeros((num_envs, hidden_size), jnp.float32),
+                    jnp.zeros((num_envs, hidden_size), jnp.float32),
+                ),
+                "prev_actions": jnp.zeros((num_envs, act_width), jnp.float32),
+                "is_first": jnp.ones((num_envs, 1), jnp.float32),
+            },
+        )
+    guard_anakin = bool(cfg.buffer.get("transfer_guard", False))
+
+    for update in range(start_iter, total_iters + 1):
+        if use_anakin:
+            # -------- fused rollout+train: ONE dispatch per update ---------
+            with timer("Time/train_time"):
+                with steady_guard(guard_anakin and update > start_iter):
+                    params, opt_state, actor_state, key, last_losses, ep_stats = anakin_step(
+                        params, opt_state, actor_state, key
+                    )
+                policy_step += num_envs * rollout_steps * fabric.num_processes
+            if cfg.metric.log_level > 0:
+                # completion arrays are tiny; the pull is D2H (legal under
+                # the H2D-scoped steady guard)
+                from sheeprl_tpu.envs.jax.anakin import episode_stats_from_device
+
+                rets, lens = episode_stats_from_device(ep_stats)
+                for ep_ret, ep_len in zip(rets, lens):
+                    aggregator.update("Rewards/rew_avg", float(ep_ret))
+                    aggregator.update("Game/ep_len_avg", int(ep_len))
+        else:
+            init_carry = (carry_np[0].copy(), carry_np[1].copy())
+            with timer("Time/env_interaction_time"):
+                with jax.default_device(host):
+                    for _ in range(rollout_steps):
+                        policy_step += num_envs * fabric.num_processes
+                        dev_obs = {
+                            k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1))
+                            for k in mlp_keys
+                        }
+                        carry, actions, logprobs, _, player_key = policy_step_fn(
+                            player_params,
+                            (jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                            dev_obs,
+                            jnp.asarray(prev_actions),
+                            jnp.asarray(is_first),
+                            player_key,
+                        )
+                        carry_np = (np.asarray(carry[0]), np.asarray(carry[1]))
+                        actions_np = np.asarray(actions)
+                        next_obs, rewards, terminated, truncated, info = envs.step(
+                            actions_for_env(actions_np, act_space)
+                        )
+                        dones = np.logical_or(terminated, truncated).astype(np.float32)
+                        rewards = np.asarray(rewards, np.float32)
+
+                        # truncation bootstrap (reference: ppo.py:287-306) using the
+                        # post-step recurrent state; padded to the full env batch
+                        if np.any(truncated):
+                            final_obs = final_obs_rows(info, np.nonzero(truncated)[0], mlp_keys)
+                            if final_obs is not None:
+                                padded = {
+                                    k: np.asarray(next_obs[k], np.float32).reshape(num_envs, -1).copy()
+                                    for k in mlp_keys
+                                }
+                                for k in mlp_keys:
+                                    padded[k][truncated] = np.asarray(final_obs[k], np.float32).reshape(
+                                        int(truncated.sum()), -1
+                                    )
+                                prev_a_boot = np.asarray(
+                                    one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
+                                )
+                                _, (_, v_boot) = agent.apply(
+                                    player_params, method=RecurrentPPOAgent.step,
+                                    carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                                    obs={k: jnp.asarray(padded[k]) for k in mlp_keys},
+                                    prev_actions=jnp.asarray(prev_a_boot),
+                                    is_first=jnp.zeros((num_envs, 1)),
+                                )
+                                v_boot = np.asarray(v_boot)[..., 0]
+                                rewards[truncated] += gamma * v_boot[truncated]
+
+                        step = {
+                            "actions": actions_np[None],
+                            "logprobs": np.asarray(logprobs)[None],
+                            "rewards": rewards[None],
+                            "dones": dones[None],
+                            "is_first": is_first[None, :, 0],
+                            "prev_actions": prev_actions[None],
+                        }
+                        for k in mlp_keys:
+                            step[k] = np.asarray(obs[k], np.float32).reshape(1, num_envs, -1)
+                        rb.add({k: v[..., None] if v.ndim == 2 else v for k, v in step.items()})
+
+                        obs = next_obs
+                        prev_actions = np.array(
+                            one_hot_actions(jnp.asarray(actions_np), actions_dim, is_continuous)
+                        )
+                        prev_actions[dones.astype(bool)] = 0.0
+                        is_first = dones[:, None]
+                        for ep_ret, ep_len in episode_stats(info):
+                            aggregator.update("Rewards/rew_avg", ep_ret)
+                            aggregator.update("Game/ep_len_avg", ep_len)
+
+            with timer("Time/train_time"):
+                # donated device staging: host-numpy layout + EXPLICIT device_puts
+                # (data/device_replay.stage_rollout), rollout donated into the
+                # one-dispatch update (see ppo.py)
+                local = rb.buffer
+                host_rollout = {k: np.asarray(local[k], np.float32) for k in mlp_keys}
+                host_rollout["actions"] = np.asarray(local["actions"])
+                host_rollout["prev_actions"] = np.asarray(local["prev_actions"])
+                host_rollout["logprobs"] = np.asarray(local["logprobs"][..., 0])
+                host_rollout["rewards"] = np.asarray(local["rewards"][..., 0])
+                host_rollout["dones"] = np.asarray(local["dones"][..., 0])
+                host_rollout["is_first"] = np.asarray(local["is_first"])  # (T, B, 1)
+                # single-process: replicate (the env-axis minibatch gathers are
+                # cheapest on replicated data); multi-host: each process only has
+                # its own env rows, so assemble the global env axis instead
+                rollout = stage_rollout(fabric, host_rollout, axis=1, sharded=sharded_envs)
+
+                # bootstrap values for the state after the rollout
+                dev_obs = {
+                    k: jnp.asarray(np.asarray(obs[k], np.float32).reshape(num_envs, -1)) for k in mlp_keys
+                }
+                _, (_, last_v) = agent.apply(
+                    player_params, method=RecurrentPPOAgent.step,
+                    carry=(jnp.asarray(carry_np[0]), jnp.asarray(carry_np[1])),
+                    obs=dev_obs, prev_actions=jnp.asarray(prev_actions),
+                    is_first=jnp.asarray(is_first),
+                )
+                key, tk = jax.random.split(key)
+                carry_pair = (np.asarray(init_carry[0]), np.asarray(init_carry[1]))
+                last_v_flat = np.asarray(last_v)[..., 0]
+                ent_dev = stage_scalar(ent_coef_v)
+                with steady_guard(guard_on and update > start_iter):
+                    params, opt_state, last_losses = train_phase(
+                        params, opt_state, rollout,
+                        fabric.shard_batch(carry_pair, axis=0) if sharded_envs else fabric.replicate(carry_pair),
+                        fabric.shard_batch(last_v_flat, axis=0) if sharded_envs else fabric.replicate(last_v_flat),
+                        tk, ent_dev, env_bs=env_bs, num_minibatches=num_minibatches,
+                    )
+                player_params = fabric.to_host(params)
+
+        # (Anakin mode anneals in-trace from the donated update counter —
+        # host-side schedule state would be a per-update H2D transfer)
+        if cfg.algo.anneal_lr and not use_anakin:
             opt_state = set_learning_rate(
                 opt_state,
                 polynomial_decay(update, initial=base_lr, final=0.0, max_decay_steps=total_iters),
             )
-        if cfg.algo.anneal_ent_coef:
+        if cfg.algo.anneal_ent_coef and not use_anakin:
             ent_coef_v = polynomial_decay(
                 update, initial=initial_ent_coef, final=0.0, max_decay_steps=total_iters
             )
@@ -437,11 +545,15 @@ def main(fabric: Any, cfg: Any) -> None:
             fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
             break
 
-    envs.close()
+    if envs is not None:
+        envs.close()
     ckpt_mgr.finalize()
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
         from sheeprl_tpu.algos.ppo_recurrent.utils import test
 
+        if use_anakin:
+            # the fused path never maintained a host player copy
+            player_params = fabric.to_host(params)
         test(agent, player_params, cfg, log_dir, logger)
     if logger is not None:
         logger.close()

@@ -447,7 +447,11 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                 aggregator.update("Loss/alpha_loss", al)
             last_log = flush_metrics(
                 aggregator, timer, logger, policy_step, last_log,
-                extra_metrics={"Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1)},
+                extra_metrics={
+                    "Params/replay_ratio": grad_step_counter * fabric.world_size / max(policy_step, 1),
+                    # deferred-sync staleness, made visible (ISSUE 12)
+                    **psync.metrics(),
+                },
             )
 
         # ---------------- checkpoint ----------------------------------------

@@ -45,15 +45,35 @@ def main(fabric: Any, cfg: Any) -> None:
     def plain_apply(critic, cp, o, a, k):
         return critic.apply(cp, o, a)
 
+    from sheeprl_tpu.parallel.topology import resolve_topology
+
+    if resolve_topology(cfg, fabric) == "sebulba":
+        # the Sebulba actor/learner device split (docs/sebulba.md)
+        from sheeprl_tpu.sebulba.sac import run_sebulba
+
+        run_sebulba(fabric, cfg)
+        return
     dedicated = (cfg.algo.get("player", {}) or {}).get("dedicated", False)
     if dedicated and fabric.num_processes > 1:
+        # DEPRECATION SHIM: the two-rank split is superseded by the Sebulba
+        # device split (topology=sebulba, docs/sebulba.md)
+        import warnings
+
+        warnings.warn(
+            "algo.player.dedicated=True (the two-rank player/trainer split) "
+            "is deprecated: use the Sebulba device split instead "
+            "(topology=sebulba topology.actor_devices=K, docs/sebulba.md). "
+            "The cross-process path still runs for now.",
+            DeprecationWarning,
+        )
         return _dedicated_main(fabric, cfg, plain_apply)
     if dedicated:
         import warnings
 
         warnings.warn(
             "algo.player.dedicated=True needs >= 2 processes (jax.distributed); "
-            "falling back to the single-controller pipelined topology",
+            "falling back to the single-controller pipelined topology "
+            "(deprecated — prefer topology=sebulba, docs/sebulba.md)",
             UserWarning,
         )
     sac_loop(fabric, cfg, build_agent, plain_apply)

@@ -513,7 +513,10 @@ def main(fabric: Any, cfg: Any) -> None:
                 aggregator.update("Loss/policy_loss", pl)
                 aggregator.update("Loss/alpha_loss", al)
                 aggregator.update("Loss/reconstruction_loss", dl)
-            last_log = flush_metrics(aggregator, timer, logger, policy_step, last_log)
+            last_log = flush_metrics(
+                aggregator, timer, logger, policy_step, last_log,
+                extra_metrics=psync.metrics(),  # deferred-sync staleness (ISSUE 12)
+            )
 
         if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
             last_checkpoint = policy_step

@@ -36,7 +36,7 @@ float32/255) in the layout the train phases already consume.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import gymnasium as gym
 import jax
@@ -83,12 +83,22 @@ def env_actions_fn(action_space: gym.Space) -> Callable:
     return lambda a: jnp.clip(a.astype(jnp.float32), low, high)
 
 
-def init_actor_state(fabric: Any, venv: VectorJaxEnv, key: jax.Array, start_update: int, sharded: bool) -> Dict[str, Any]:
+def init_actor_state(
+    fabric: Any,
+    venv: VectorJaxEnv,
+    key: jax.Array,
+    start_update: int,
+    sharded: bool,
+    extra: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
     """Reset the batched env and stage the persistent actor carry onto the
     mesh: env-dimension leaves shard over ``data`` via the sharding
     engine's env-state spec (``parallel/sharding.env_state_sharding`` —
     the replay-ring placement, one axis earlier) when the env count
-    divides the data degree, else replicate."""
+    divides the data degree, else replicate.  ``extra`` adds further
+    env-leading-axis carry leaves under the same placement law (the
+    recurrent loop's LSTM state / prev-action encoding / episode-start
+    mask)."""
     from sheeprl_tpu.parallel.sharding import env_state_sharding
 
     env_state, _ = venv.reset(key)
@@ -96,6 +106,7 @@ def init_actor_state(fabric: Any, venv: VectorJaxEnv, key: jax.Array, start_upda
         "env": env_state,
         "ep_ret": jnp.zeros((venv.num_envs,), jnp.float32),
         "ep_len": jnp.zeros((venv.num_envs,), jnp.int32),
+        **(extra or {}),
     }
     placement = (
         env_state_sharding(fabric.mesh, venv.num_envs, fabric.data_axis)
@@ -177,6 +188,109 @@ def make_rollout_fn(
             "update": actor["update"] + 1,
         }
         return new_actor, traj, last_obs, stats
+
+    return rollout
+
+
+def make_recurrent_rollout_fn(
+    venv: VectorJaxEnv,
+    step_apply: Callable,
+    sample_fn: Callable,
+    encode_prev_actions: Callable,
+    *,
+    mlp_keys: Sequence[str],
+    action_space: gym.Space,
+    gamma: float,
+    rollout_steps: int,
+) -> Callable:
+    """The recurrent (LSTM) twin of :func:`make_rollout_fn` for
+    ``ppo_recurrent`` (ROADMAP item 5's remaining half): the ``nn.scan``
+    policy's per-step method runs INSIDE the fused ``lax.scan`` rollout,
+    with the recurrent state, previous-action encoding and episode-start
+    mask all living in the donated device-resident actor carry.
+
+    ``step_apply(p, carry, obs, prev_actions, is_first) -> (carry',
+    (actor_out, value))`` is the agent's single-step apply;
+    ``encode_prev_actions(actions)`` is the next-step action encoding
+    (one-hot per discrete branch).  Returns ``rollout(p, actor, key) ->
+    (actor', rollout, init_carry, last_values, stats)`` where ``rollout``
+    carries the extra ``prev_actions``/``is_first`` sequences the
+    recurrent train phase consumes, ``init_carry`` is the recurrent state
+    at the segment start and ``last_values`` the bootstrap values after
+    the last step — everything the existing ``ppo_recurrent`` train phase
+    takes, computed without a single host↔device transfer.
+
+    Truncation bootstrap uses the POST-step recurrent state on the true
+    final observation (the host loop's padded re-dispatch, in-trace).
+    """
+    prep = prep_obs_fn((), mlp_keys)
+    to_env = env_actions_fn(action_space)
+    num_envs = venv.num_envs
+
+    def rollout(p: Any, actor: Dict[str, Any], key: jax.Array):
+        init_carry = actor["carry"]
+
+        def body(carry, k_step):
+            env_state, (c, h), prev_actions, is_first, ep_ret, ep_len = carry
+            pobs = prep(venv.observe(env_state))
+            (c2, h2), (actor_out, value) = step_apply(p, (c, h), pobs, prev_actions, is_first)
+            actions, logprob = sample_fn(actor_out, k_step)
+            env_state, _, reward, term, trunc, final_obs = venv.step(env_state, to_env(actions))
+            prev_a_next = encode_prev_actions(actions)
+            # truncation bootstrap with the post-step recurrent state
+            _, (_, v_final) = step_apply(
+                p, (c2, h2), prep(final_obs), prev_a_next,
+                jnp.zeros((num_envs, 1), jnp.float32),
+            )
+            trunc_f = trunc.astype(jnp.float32)
+            boot_reward = reward + gamma * v_final[..., 0] * trunc_f
+            done = jnp.logical_or(term, trunc)
+            done_f = done.astype(jnp.float32)
+            ep_ret = ep_ret + reward
+            ep_len = ep_len + 1
+            step_out = {
+                **pobs,
+                "actions": actions,
+                "logprobs": logprob,
+                "rewards": boot_reward,
+                "dones": done_f,
+                "is_first": is_first,
+                "prev_actions": prev_actions,
+                "ep_done": done,
+                "ep_ret": ep_ret,
+                "ep_len": ep_len,
+            }
+            ep_ret = ep_ret * (1.0 - done_f)
+            ep_len = ep_len * (1 - done.astype(jnp.int32))
+            # episode boundary resets the next step's recurrent inputs
+            prev_a_next = prev_a_next * (1.0 - done_f[..., None])
+            is_first_next = done_f[..., None]
+            return (env_state, (c2, h2), prev_a_next, is_first_next, ep_ret, ep_len), step_out
+
+        keys = jax.random.split(key, rollout_steps)
+        (env_state, carry2, prev_actions, is_first, ep_ret, ep_len), traj = jax.lax.scan(
+            body,
+            (
+                actor["env"], actor["carry"], actor["prev_actions"],
+                actor["is_first"], actor["ep_ret"], actor["ep_len"],
+            ),
+            keys,
+        )
+        stats = {k: traj.pop(k) for k in ("ep_done", "ep_ret", "ep_len")}
+        # bootstrap values for the post-rollout state, with the live carry
+        _, (_, last_v) = step_apply(
+            p, carry2, prep(venv.observe(env_state)), prev_actions, is_first
+        )
+        new_actor = {
+            "env": env_state,
+            "carry": carry2,
+            "prev_actions": prev_actions,
+            "is_first": is_first,
+            "ep_ret": ep_ret,
+            "ep_len": ep_len,
+            "update": actor["update"] + 1,
+        }
+        return new_actor, traj, init_carry, last_v[..., 0], stats
 
     return rollout
 

@@ -781,15 +781,44 @@ class PlayerSync:
         self.sync_every = max(1, int(player_cfg.get("sync_every", 1)))
         self._pending: Any = None
         self._windows = 0  # completed training windows (dispatches)
+        # staleness accounting (ISSUE 12 satellite): which window produced
+        # the weights the player is CURRENTLY acting with (0 = init params)
+        self._player_version = 0
+        self._pending_version = 0
+        self.staleness_max = 0
 
     def init(self, params: Any) -> Any:
+        self._player_version = self._windows
+        self._pending = None
         return self.fabric.copy_to(self.extract(params), self.device)
+
+    @property
+    def staleness(self) -> int:
+        """Completed training windows the player's weights are behind —
+        the deferred-sync/cadence staleness, previously invisible.  Bound:
+        ``sync_every - 1`` with immediate sync (the off-cadence windows
+        before each refresh), ``sync_every`` deferred (the pending params
+        land one ``before_dispatch`` later)."""
+        return self._windows - self._player_version
+
+    def _observe_staleness(self) -> None:
+        self.staleness_max = max(self.staleness_max, self.staleness)
+
+    def metrics(self) -> Dict[str, float]:
+        """``Player/*`` staleness gauges for ``flush_metrics`` callers."""
+        return {
+            "Player/param_staleness_windows": float(self.staleness),
+            "Player/param_staleness_max": float(self.staleness_max),
+        }
 
     def before_dispatch(self, player_params: Any) -> Any:
         """Pull the previous window's (long since finished) train output."""
         if self._pending is not None:
             pending, self._pending = self._pending, None
+            self._player_version = self._pending_version
+            self._observe_staleness()
             return self.fabric.copy_to(self.extract(pending), self.device)
+        self._observe_staleness()
         return player_params
 
     def after_dispatch(self, params: Any, player_params: Any) -> Any:
@@ -800,10 +829,15 @@ class PlayerSync:
         # on init weights forever).
         self._windows += 1
         if self._windows % self.sync_every != 0:
+            self._observe_staleness()
             return player_params
         if self.deferred:
             self._pending = params
+            self._pending_version = self._windows
+            self._observe_staleness()
             return player_params
+        self._player_version = self._windows
+        self._observe_staleness()
         return self.fabric.copy_to(self.extract(params), self.device)
 
     # -- checkpointing ------------------------------------------------------
@@ -819,6 +853,10 @@ class PlayerSync:
 
     def load_state_dict(self, state: Dict[str, int]) -> None:
         self._windows = int(state.get("windows", 0))
+        # resume starts the player from the checkpointed (latest) params —
+        # see state_dict: staleness restarts at zero, only cadence persists
+        self._player_version = self._windows
+        self._pending = None
 
 
 def _packed_copy(leaves: Any, device: Any) -> Any:
@@ -929,6 +967,28 @@ def trainer_device_count(fabric: Fabric, player_process: int = 0) -> int:
     return sum(1 for d in fabric.mesh.devices.flat if d.process_index != player_process)
 
 
+def clone_with_devices(fabric: Fabric, devices: List[Any]) -> Fabric:
+    """A fabric sharing ``fabric``'s policy state (precision, callbacks,
+    sharding config, checkpoint manager) whose 1-D ``data`` mesh spans only
+    ``devices`` — THE device-subset surgery shared by the dedicated-player
+    trainer group and the Sebulba learner sub-mesh.  New ``Fabric.__init__``
+    state must be mirrored here, in ONE place."""
+    sub = Fabric.__new__(Fabric)
+    sub.strategy = fabric.strategy
+    sub.precision = fabric.precision
+    sub.callbacks = fabric.callbacks
+    sub._callback_cfg = fabric._callback_cfg
+    sub.devices = list(devices)
+    sub.accelerator = fabric.accelerator
+    sub.mesh = Mesh(np.asarray(list(devices)), ("data",))
+    sub.data_axis = "data"
+    sub.tp_min_param_size = fabric.tp_min_param_size
+    sub.sharding_cfg = dict(fabric.sharding_cfg)
+    sub._sharding_rules = None
+    sub.checkpoint_manager = fabric.checkpoint_manager
+    return sub
+
+
 def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
     """A fabric whose mesh spans only the devices NOT owned by the dedicated
     player process — the trainer group of the cross-process decoupled
@@ -943,20 +1003,7 @@ def get_trainer_fabric(fabric: Fabric, player_process: int = 0) -> Fabric:
             "dedicated-player topology needs at least one device owned by a "
             "non-player process (got none; run with >= 2 processes)"
         )
-    sub = Fabric.__new__(Fabric)
-    sub.strategy = fabric.strategy
-    sub.precision = fabric.precision
-    sub.callbacks = fabric.callbacks
-    sub._callback_cfg = fabric._callback_cfg
-    sub.devices = trainer_devices
-    sub.accelerator = fabric.accelerator
-    sub.mesh = Mesh(np.asarray(trainer_devices), ("data",))
-    sub.data_axis = "data"
-    sub.tp_min_param_size = fabric.tp_min_param_size
-    sub.sharding_cfg = dict(fabric.sharding_cfg)
-    sub._sharding_rules = None
-    sub.checkpoint_manager = fabric.checkpoint_manager
-    return sub
+    return clone_with_devices(fabric, trainer_devices)
 
 
 def get_single_device_fabric(fabric: Fabric, device: Optional[Any] = None) -> Fabric:

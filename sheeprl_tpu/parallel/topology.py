@@ -1,0 +1,330 @@
+"""Sebulba device-group topology: actor/learner mesh split + param broadcast.
+
+Podracer's Sebulba architecture (arXiv:2104.06272; TorchBeast's actor/learner
+split, arXiv:1910.03552) divides the devices of one pod between two roles:
+
+* **actor devices** run batched policy inference (or, for pure-JAX envs,
+  whole fused rollout shards) and produce trajectories;
+* **learner devices** own the training mesh: they consume a device-resident
+  trajectory queue and run the optimization program, with gradients
+  all-reduced over the learner sub-mesh only.
+
+Parameters flow learner → actors as a device-to-device broadcast (the
+:class:`ParamBroadcast` below), replacing the point-to-point
+:class:`~sheeprl_tpu.parallel.fabric.PlayerSync` host pulls of the pipelined
+decoupled loops.  Staleness — how many learner updates behind the actors'
+weights are — is *bounded* (``topology.max_staleness`` gates the learner)
+and *reported* (``Sebulba/*`` metrics), instead of being an accident of
+dispatch timing.
+
+This module owns the device bookkeeping only; the queues, actor loops and
+per-algorithm drivers live in :mod:`sheeprl_tpu.sebulba`.
+
+Config surface (the ``topology`` Hydra group)::
+
+    topology:
+      name: auto            # auto | pipelined | sebulba
+      actor_devices: 1      # devices in the actor group (int)
+      learner_devices: -1   # devices in the learner group (-1 = the rest)
+      ...                   # queue/worker knobs read by sheeprl_tpu.sebulba
+
+``name: auto`` selects sebulba only when the split is explicitly sized
+(``actor_devices`` non-null) — existing decoupled configs keep the
+single-controller pipelined path untouched.  ``name: sebulba`` demands the
+split (defaulting to one actor device) and raises where it cannot exist.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.parallel.fabric import Fabric
+
+
+def topology_cfg(cfg: Any) -> Dict[str, Any]:
+    """The ``topology`` config group as a plain dict (tolerates configs
+    composed before the group existed — external callers, old tests)."""
+    raw = cfg.get("topology") if hasattr(cfg, "get") else None
+    return dict(raw) if raw else {}
+
+
+def resolve_topology(cfg: Any, fabric: Fabric) -> str:
+    """Which decoupled topology this run should use: ``"sebulba"`` or
+    ``"pipelined"``.
+
+    ``auto`` (the default) upgrades to sebulba only when the user sized the
+    device split (``topology.actor_devices`` set): the pipelined
+    single-controller loop *is* the degenerate sebulba (both roles
+    time-share every device), and silently re-topologizing existing runs
+    would change their compile set and overlap semantics.  ``sebulba``
+    forces the split and raises where it cannot exist (multi-process, or a
+    tensor-parallel ``model`` mesh axis — the learner sub-mesh is 1-D).
+    """
+    topo = topology_cfg(cfg)
+    name = str(topo.get("name", "auto")).lower()
+    if name == "pipelined":
+        return "pipelined"
+    if name not in ("auto", "sebulba"):
+        raise ValueError(
+            f"topology.name must be auto|pipelined|sebulba, got {name!r}"
+        )
+    wanted = name == "sebulba" or topo.get("actor_devices") is not None
+    if not wanted:
+        return "pipelined"
+    reasons = []
+    if fabric.num_processes > 1:
+        reasons.append("multi-process runs (the split is single-controller)")
+    if fabric.model_axis is not None:
+        reasons.append("a tensor-parallel 'model' mesh axis")
+    if reasons:
+        if name == "sebulba":
+            raise ValueError(
+                "topology=sebulba does not support " + " or ".join(reasons)
+            )
+        import warnings
+
+        warnings.warn(
+            "topology.actor_devices set but the run cannot split devices "
+            f"({'; '.join(reasons)}); falling back to the pipelined topology",
+            RuntimeWarning,
+        )
+        return "pipelined"
+    return "sebulba"
+
+
+def _submesh_fabric(fabric: Fabric, devices: List[Any]) -> Fabric:
+    """A fabric whose 1-D ``data`` mesh spans only ``devices`` — the shared
+    :func:`~sheeprl_tpu.parallel.fabric.clone_with_devices` surgery over an
+    arbitrary single-process device subset (the learner group)."""
+    from sheeprl_tpu.parallel.fabric import clone_with_devices
+
+    return clone_with_devices(fabric, devices)
+
+
+@dataclass
+class DeviceTopology:
+    """The resolved actor/learner device split of one mesh.
+
+    ``actor_devices`` / ``learner_devices`` are disjoint (except in the
+    degenerate single-device case, where both roles share the one device —
+    functional, documented, and warned about).  ``learner_fabric`` is a
+    1-D-data-mesh fabric over the learner group: the training program, its
+    batch sharding, and the device-resident trajectory queue all live
+    there.  Actors are per-device inference engines, so they get plain
+    device handles, not a mesh.
+    """
+
+    fabric: Fabric
+    actor_devices: List[Any]
+    learner_devices: List[Any]
+    learner_fabric: Fabric = field(init=False)
+    shared: bool = False  # one device playing both roles
+
+    def __post_init__(self) -> None:
+        self.learner_fabric = _submesh_fabric(self.fabric, self.learner_devices)
+
+    @property
+    def num_actors(self) -> int:
+        return len(self.actor_devices)
+
+    @property
+    def num_learners(self) -> int:
+        return len(self.learner_devices)
+
+    def describe(self) -> str:
+        a = ", ".join(str(d) for d in self.actor_devices)
+        l = ", ".join(str(d) for d in self.learner_devices)
+        tag = " (shared device: degenerate split)" if self.shared else ""
+        return f"sebulba topology{tag}: actors=[{a}] learners=[{l}]"
+
+    @classmethod
+    def from_config(cls, fabric: Fabric, cfg: Any) -> "DeviceTopology":
+        """Split ``fabric``'s mesh devices per ``topology.actor_devices`` /
+        ``topology.learner_devices``, validated against the mesh size.
+
+        ``learner_devices: -1`` (default) takes every device the actor
+        group left.  A 1-device mesh degenerates to both groups sharing the
+        device (warned): every code path still runs, which is what CI
+        single-device smoke cells need.
+        """
+        topo = topology_cfg(cfg)
+        devices = list(fabric.mesh.devices.flat)
+        n = len(devices)
+        a = topo.get("actor_devices")
+        a = 1 if a is None else int(a)
+        l_raw = topo.get("learner_devices", -1)
+        l = -1 if l_raw is None else int(l_raw)
+        if n == 1:
+            import warnings
+
+            warnings.warn(
+                "topology=sebulba on a 1-device mesh: actor and learner "
+                "groups share the device (no real split; use >= 2 devices "
+                "for the actor/learner overlap)",
+                RuntimeWarning,
+            )
+            return cls(fabric, [devices[0]], [devices[0]], shared=True)
+        if a < 1:
+            raise ValueError(f"topology.actor_devices must be >= 1, got {a}")
+        if a >= n:
+            raise ValueError(
+                f"topology.actor_devices={a} leaves no learner devices on a "
+                f"{n}-device mesh (mesh {dict(fabric.mesh.shape)})"
+            )
+        if l == -1:
+            l = n - a
+        if l < 1:
+            raise ValueError(f"topology.learner_devices must be >= 1 or -1, got {l}")
+        if a + l > n:
+            raise ValueError(
+                f"topology.actor_devices={a} + learner_devices={l} exceeds "
+                f"the {n}-device mesh (mesh {dict(fabric.mesh.shape)})"
+            )
+        if a + l < n:
+            import warnings
+
+            warnings.warn(
+                f"topology: {n - a - l} of {n} mesh devices are assigned to "
+                "neither group and will idle",
+                RuntimeWarning,
+            )
+        return cls(fabric, devices[:a], devices[a : a + l])
+
+
+class StalenessExceeded(RuntimeError):
+    """The learner waited past its deadline for actors to pick up fresh
+    params (``topology.max_staleness`` gate)."""
+
+
+class ParamBroadcast:
+    """Learner → actor device-to-device parameter broadcast with a bounded,
+    *observable* staleness contract.
+
+    The learner calls :meth:`publish` after each optimization step: the
+    (actor-relevant subtree of the) fresh params are copied onto every
+    actor device — ``fabric.copy_to`` per target, i.e. a real device-to-
+    device transfer (packed per dtype cross-platform), never a host
+    round-trip through pickled numpy like the retired ``PlayerSync`` pull
+    path.  Actors call :meth:`fetch` before each inference dispatch and
+    always receive the newest published version.
+
+    The staleness bound: before optimization step ``v+1`` the learner calls
+    :meth:`gate`, which blocks until every actor has fetched a version
+    ``>= v - max_staleness``.  Since actors fetch-before-dispatch, an actor
+    batch is therefore computed with weights at most ``max_staleness``
+    learner updates behind the weights being trained — the knob trades
+    actor/learner decoupling against off-policyness, and the observed gap
+    is reported as ``Sebulba/param_staleness``.
+    """
+
+    def __init__(
+        self,
+        fabric: Fabric,
+        actor_devices: List[Any],
+        extract: Callable[[Any], Any] = lambda p: p,
+        max_staleness: int = 2,
+        gate_timeout_s: float = 300.0,
+    ):
+        self.fabric = fabric
+        self.actor_devices = list(actor_devices)
+        self.extract = extract
+        self.max_staleness = int(max_staleness)
+        self.gate_timeout_s = float(gate_timeout_s)
+        self._lock = threading.Lock()
+        self._fetched = threading.Condition(self._lock)
+        self._version = 0
+        self._params: List[Any] = [None] * len(self.actor_devices)
+        self._fetched_version = [0] * len(self.actor_devices)
+        # observability (read under the lock, flushed as Sebulba/* metrics)
+        self.publishes = 0
+        self.gate_wait_s = 0.0
+        self.staleness_sum = 0
+        self.staleness_max = 0
+        self.fetches = 0
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def publish(self, params: Any, version: Optional[int] = None) -> int:
+        """Copy the actor subtree of ``params`` onto every actor device and
+        stamp it with ``version`` (defaults to the next integer).  Called by
+        the learner right after its (async-dispatched) update — the D2D
+        copies are enqueued behind the update, so by the time an actor
+        dispatch reads them the device has finished both."""
+        sub = self.extract(params)
+        copies = [self.fabric.copy_to(sub, d) for d in self.actor_devices]
+        with self._lock:
+            first = self.publishes == 0
+            self._version = int(version) if version is not None else self._version + 1
+            if first:
+                # the FIRST publish defines the baseline (a resumed run
+                # publishes its checkpointed version): seeding the fetch
+                # cursors here keeps staleness metrics measuring lag, not
+                # the absolute resume offset
+                self._fetched_version = [self._version] * len(self.actor_devices)
+            self._params = copies
+            self.publishes += 1
+            self._fetched.notify_all()
+            return self._version
+
+    def fetch(self, actor_index: int) -> tuple:
+        """Newest published ``(params, version)`` for one actor engine;
+        records the fetch for the staleness gate and metrics.  Returns
+        ``(None, 0)`` before the first publish."""
+        with self._lock:
+            params = self._params[actor_index]
+            version = self._version
+            lag = version - self._fetched_version[actor_index]
+            self._fetched_version[actor_index] = version
+            self.fetches += 1
+            self.staleness_sum += lag
+            self.staleness_max = max(self.staleness_max, lag)
+            self._fetched.notify_all()
+            return params, version
+
+    def staleness(self, actor_index: int) -> int:
+        """How many published versions behind this actor's last fetch is."""
+        with self._lock:
+            return self._version - self._fetched_version[actor_index]
+
+    def gate(self, timeout_s: Optional[float] = None) -> float:
+        """Block the learner until every actor's last-fetched version is
+        within ``max_staleness`` of the current one.  Returns seconds
+        waited; raises :class:`StalenessExceeded` past the deadline (a
+        wedged actor must fail the run loudly, not silently train on a
+        frozen data distribution)."""
+        deadline = time.monotonic() + (
+            self.gate_timeout_s if timeout_s is None else float(timeout_s)
+        )
+        t0 = time.monotonic()
+        with self._lock:
+            while self._version - min(self._fetched_version) > self.max_staleness:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    lags = [self._version - f for f in self._fetched_version]
+                    raise StalenessExceeded(
+                        f"actors still {lags} versions behind after "
+                        f"{self.gate_timeout_s}s (max_staleness="
+                        f"{self.max_staleness})"
+                    )
+                self._fetched.wait(remaining)
+        waited = time.monotonic() - t0
+        with self._lock:
+            self.gate_wait_s += waited
+        return waited
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "Sebulba/param_version": float(self._version),
+                "Sebulba/param_staleness_max": float(self.staleness_max),
+                "Sebulba/param_staleness_avg": (
+                    self.staleness_sum / self.fetches if self.fetches else 0.0
+                ),
+                "Sebulba/param_gate_wait_s": float(self.gate_wait_s),
+            }

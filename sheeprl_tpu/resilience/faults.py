@@ -52,6 +52,8 @@ KNOWN_SITES = (
     "serve.http",
     "fabric.copy_to",
     "replay.spill",
+    "sebulba.env_worker",
+    "sebulba.traj_queue",
 )
 
 KINDS = ("raise", "hang", "latency", "corrupt", "truncate")
@@ -61,8 +63,9 @@ KINDS = ("raise", "hang", "latency", "corrupt", "truncate")
 BYTE_SITES = ("checkpoint.write_shard",)
 
 #: Sites whose hook passes replay rows (``fault_rows``): ``truncate`` there
-#: tail-halves the queued rows (a torn spill write), not a byte payload.
-ROW_SITES = ("replay.spill",)
+#: tail-halves the queued rows (a torn spill write / a torn trajectory
+#: segment), not a byte payload.
+ROW_SITES = ("replay.spill", "sebulba.traj_queue")
 
 ENV_VAR = "SHEEPRL_FAULT_PLAN"
 

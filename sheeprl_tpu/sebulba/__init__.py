@@ -1,0 +1,23 @@
+"""Sebulba pod-scale actor–learner runtime (Podracer, arXiv:2104.06272).
+
+The decoupled algorithms route here when ``topology=sebulba`` resolves
+(see :mod:`sheeprl_tpu.parallel.topology` and docs/sebulba.md): mesh
+devices split into an actor group (batched AOT inference / fused jax-env
+rollout shards) and a learner group (the training sub-mesh consuming a
+device-resident trajectory queue), with learner→actor parameter flow as a
+staleness-bounded device-to-device broadcast.
+"""
+
+from sheeprl_tpu.sebulba.actor import (  # noqa: F401
+    ActorEngine,
+    EnvWorker,
+    FusedActor,
+    WorkerSupervisor,
+    derive_ladder,
+)
+from sheeprl_tpu.sebulba.queues import (  # noqa: F401
+    ObsBlock,
+    ObsQueue,
+    TornTrajectory,
+    TrajQueue,
+)

@@ -1,0 +1,530 @@
+"""Sebulba actor side: batched AOT inference engines + env-worker drivers.
+
+One :class:`ActorEngine` runs per actor device (Sebulba co-locates an
+inference engine with each actor core): a dispatcher thread coalesces env
+workers' observation blocks off the shared :class:`~sheeprl_tpu.sebulba.
+queues.ObsQueue` (serve-batcher max-batch/max-wait policy), pads the batch
+up to a static **ladder** rung, and dispatches ONE AOT executable per rung
+(``parallel/compile.py`` — each rung is its own compile-once program, so
+every executable holds ``cache_size() == 1`` for the life of the run).
+
+Env workers are lightweight *drivers*: each owns ``num_envs/env_workers``
+envs through the standard ``utils.env.vectorize`` machinery (with
+``env.sync_env=False`` the actual stepping runs in ``AsyncVectorEnv``
+subprocesses), submits its observation block per step, and assembles
+fixed-length trajectory segments that it pushes into the device-resident
+:class:`~sheeprl_tpu.sebulba.queues.TrajQueue`.  Workers heartbeat a
+:class:`~sheeprl_tpu.resilience.retry.Watchdog`; a crashed or hung worker
+(the ``sebulba.env_worker`` fault site) is **deposed and respawned** with
+fresh envs — a deposed worker can never push again, so partial segments
+die with it and torn trajectories cannot reach the learner.
+
+For pure-JAX envs the actor group skips the queue entirely:
+:class:`FusedActor` runs an Anakin-style fused rollout shard per actor
+device (the whole ``lax.scan`` rollout is one executable, H2D-free in
+steady state) and ships finished segments device-to-device into the
+trajectory queue.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from sheeprl_tpu.parallel.compile import AOTFunction, compile_once
+from sheeprl_tpu.parallel.topology import ParamBroadcast
+from sheeprl_tpu.resilience.faults import fault_point
+from sheeprl_tpu.sebulba.queues import ObsBlock, ObsQueue, ServiceStopped, TrajQueue
+from sheeprl_tpu.serve.batcher import pick_ladder_size
+
+
+def derive_ladder(block_rows: int, max_blocks: int, override: Optional[Sequence[int]] = None) -> Tuple[int, ...]:
+    """The static batch ladder for actor inference: multiples of the
+    per-worker block size in powers of two, topped by the full round
+    (``block_rows * max_blocks``) so a fully-coalesced step pads nothing."""
+    if override:
+        ladder = sorted({int(b) for b in override})
+        if any(b % block_rows for b in ladder):
+            raise ValueError(
+                f"topology.actor_batch_ladder {ladder} must be multiples of "
+                f"the worker block size ({block_rows} rows)"
+            )
+        return tuple(ladder)
+    sizes = set()
+    b = block_rows
+    while b < block_rows * max_blocks:
+        sizes.add(b)
+        b *= 2
+    sizes.add(block_rows * max_blocks)
+    return tuple(sorted(sizes))
+
+
+class ActorEngine(threading.Thread):
+    """One actor device's batched-inference dispatcher.
+
+    ``policy_fn(params, obs, key) -> (outputs, key')`` is the algo's pure
+    per-row policy (outputs: dict of row-major arrays).  Each ladder rung
+    gets its OWN compile-once executable (``sebulba.actor_step[i]@rung``),
+    warmed ahead of traffic via :meth:`warmup`; the dispatcher then only
+    ever feeds data.  Params arrive by device-to-device broadcast
+    (:class:`ParamBroadcast`); the PRNG key lives on the actor device and
+    advances inside the executable, so a steady-state dispatch moves only
+    the observation batch host→device (and nothing at all for device-fed
+    observations).
+    """
+
+    def __init__(
+        self,
+        index: int,
+        device: Any,
+        policy_fn: Callable,
+        obs_spec: Dict[str, Tuple[Tuple[int, ...], Any]],
+        param_spec: Any,
+        ladder: Sequence[int],
+        block_rows: int,
+        obs_queue: ObsQueue,
+        broadcast: ParamBroadcast,
+        key: jax.Array,
+        *,
+        max_wait_s: float = 0.02,
+        max_recompiles: Optional[int] = None,
+        name: str = "sebulba.actor",
+    ):
+        super().__init__(name=f"{name}[{index}]", daemon=True)
+        self.index = int(index)
+        self.device = device
+        self.ladder = tuple(sorted(int(b) for b in ladder))
+        self.block_rows = int(block_rows)
+        self.obs_queue = obs_queue
+        self.broadcast = broadcast
+        self.max_wait_s = float(max_wait_s)
+        self._obs_spec = dict(obs_spec)
+        self._param_spec = param_spec
+        self._key = jax.device_put(key, device)
+        self._stop_event = threading.Event()
+        self.error: Optional[BaseException] = None
+        # observability
+        self.dispatches = 0
+        self.rows_served = 0
+        self.rows_padded = 0
+        self.idle_s = 0.0
+        self.busy_s = 0.0
+        self._started_at: Optional[float] = None
+
+        # one compile-once program PER LADDER RUNG — "one executable per
+        # batch-ladder size": each AOTFunction sees exactly one abstract
+        # signature, so cache_size()==1 is the per-rung steady-state law
+        self.executables: Dict[int, AOTFunction] = {
+            rung: compile_once(
+                policy_fn,
+                name=f"sebulba.actor_step[{index}]@{rung}",
+                max_recompiles=max_recompiles,
+            )
+            for rung in self.ladder
+        }
+
+    # -- warm-up --------------------------------------------------------------
+    def _specs_for(self, rung: int) -> Tuple[Any, Any, Any]:
+        from jax.sharding import SingleDeviceSharding
+
+        sd = SingleDeviceSharding(self.device)
+        obs = {
+            k: jax.ShapeDtypeStruct((rung,) + tuple(shape), dtype, sharding=sd)
+            for k, (shape, dtype) in self._obs_spec.items()
+        }
+        params = jax.tree.map(
+            lambda s: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sd), self._param_spec
+        )
+        key = jax.ShapeDtypeStruct(self._key.shape, self._key.dtype, sharding=sd)
+        return params, obs, key
+
+    def warmup(self, pool: Any = None, join: bool = True) -> None:
+        """AOT-compile every rung (concurrently on the compile pool) before
+        traffic — steady state then never compiles."""
+        from sheeprl_tpu.parallel.compile import get_compile_pool
+
+        pool = pool or get_compile_pool()
+        futures = [
+            pool.submit(self.executables[rung], *self._specs_for(rung)) for rung in self.ladder
+        ]
+        if join:
+            pool.join()
+        return futures
+
+    def cache_sizes(self) -> Dict[int, int]:
+        return {rung: fn.cache_size() for rung, fn in self.executables.items()}
+
+    # -- dispatch loop --------------------------------------------------------
+    def stop(self) -> None:
+        self._stop_event.set()
+
+    def actor_idle_frac(self) -> float:
+        total = self.idle_s + self.busy_s
+        return self.idle_s / total if total > 0 else 0.0
+
+    def _dispatch(self, blocks: List[ObsBlock]) -> None:
+        rows = sum(b.rows for b in blocks)
+        rung = pick_ladder_size(rows, self.ladder)
+        batch: Dict[str, np.ndarray] = {}
+        for k, (shape, dtype) in self._obs_spec.items():
+            buf = np.zeros((rung,) + tuple(shape), dtype)
+            at = 0
+            for b in blocks:
+                buf[at : at + b.rows] = b.obs[k]
+                at += b.rows
+            batch[k] = buf
+        params, version = self.broadcast.fetch(self.index)
+        dev_batch = jax.device_put(batch, self.device)
+        outputs, self._key = self.executables[rung](params, dev_batch, self._key)
+        outputs = {k: np.asarray(v) for k, v in outputs.items()}
+        self.dispatches += 1
+        self.rows_served += rows
+        self.rows_padded += rung - rows
+        at = 0
+        for b in blocks:
+            row_out = {k: v[at : at + b.rows] for k, v in outputs.items()}
+            row_out["_version"] = version
+            at += b.rows
+            b.resolve(row_out)
+
+    def run(self) -> None:
+        self._started_at = time.perf_counter()
+        max_blocks = max(self.ladder) // self.block_rows
+        try:
+            while not self._stop_event.is_set():
+                t0 = time.perf_counter()
+                blocks = self.obs_queue.get_batch(max_blocks, self.max_wait_s)
+                self.idle_s += time.perf_counter() - t0
+                blocks = [b for b in blocks if not b.cancelled]
+                if not blocks:
+                    if self.obs_queue.closed:
+                        break
+                    continue
+                t1 = time.perf_counter()
+                try:
+                    self._dispatch(blocks)
+                except BaseException as e:  # noqa: BLE001 — fail the callers, then re-raise
+                    for b in blocks:
+                        b.fail(e)
+                    raise
+                self.busy_s += time.perf_counter() - t1
+        except BaseException as e:  # noqa: BLE001 — surfaced by the runner
+            if not self._stop_event.is_set():
+                self.error = e
+
+
+class EnvWorker(threading.Thread):
+    """One env-worker driver: steps its env slice, requests actions from
+    the actor group, assembles fixed-length segments.
+
+    ``protocol`` owns the algorithm-specific step semantics through one
+    method::
+
+        run_segment(infer, envs, obs, steps)
+            -> (next_obs, segment_dict, episode_stats, env_steps)
+
+    where ``infer(block) -> (outputs, version)`` round-trips one
+    observation block through the actor group.  A worker whose
+    :attr:`deposed` flag is set (the supervisor decided it is wedged)
+    exits at the next boundary and never pushes a segment again.
+    """
+
+    def __init__(
+        self,
+        worker_id: int,
+        env_builder: Callable[[], Any],
+        protocol: Any,
+        obs_queue: ObsQueue,
+        traj_queue: TrajQueue,
+        rollout_steps: int,
+        seed: int,
+        *,
+        timeout_s: float = 300.0,
+        stop_event: Optional[threading.Event] = None,
+        stats_sink: Optional[Callable[[Sequence[Tuple[float, int]]], None]] = None,
+        generation: int = 0,
+    ):
+        super().__init__(name=f"sebulba.env_worker[{worker_id}]g{generation}", daemon=True)
+        self.worker_id = int(worker_id)
+        self.env_builder = env_builder
+        self.protocol = protocol
+        self.obs_queue = obs_queue
+        self.traj_queue = traj_queue
+        self.rollout_steps = int(rollout_steps)
+        self.seed = int(seed)
+        self.timeout_s = float(timeout_s)
+        self.stop_event = stop_event or threading.Event()
+        self.stats_sink = stats_sink
+        self.generation = int(generation)
+        self.deposed = False
+        self.error: Optional[BaseException] = None
+        self.last_beat = time.monotonic()
+        self.segments_pushed = 0
+        self.env_steps = 0
+        self._last_version = 0
+
+    # -- actor round-trip -----------------------------------------------------
+    def infer(self, block: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        rows = int(next(iter(block.values())).shape[0])
+        req = ObsBlock(self.worker_id, block, rows)
+        self.obs_queue.put(req, block=True, timeout=self.timeout_s)
+        # wait in slices, touching the heartbeat: legitimately queueing
+        # behind a slow actor dispatch is LIVENESS, not a hang — only a
+        # worker that stops reaching this loop goes stale
+        deadline = time.monotonic() + self.timeout_s
+        while not req.event.wait(0.25):
+            self.touch()
+            if self.deposed:
+                req.cancelled = True
+                raise _Deposed()
+            if time.monotonic() > deadline:
+                req.cancelled = True
+                raise TimeoutError("actor inference request timed out")
+        if req.error is not None:
+            raise req.error
+        out = req.result
+        self._last_version = int(out.get("_version", self._last_version))
+        return out
+
+    def touch(self) -> None:
+        """Refresh the heartbeat WITHOUT the fault site (used from waits
+        where the worker is blocked but healthy)."""
+        self.last_beat = time.monotonic()
+
+    def beat(self) -> None:
+        self.last_beat = time.monotonic()
+        # the sebulba.env_worker fault site fires per env step, from the
+        # worker's own thread: `raise` kills the worker (crash drill),
+        # `hang` wedges it past the supervisor deadline (hang drill)
+        fault_point("sebulba.env_worker")
+        if self.deposed:
+            raise _Deposed()
+
+    def _push_abort(self) -> bool:
+        """Generation fence evaluated by ``TrajQueue.put`` UNDER ITS LOCK
+        right before the append (and on every backpressure wait slice,
+        where it also refreshes the heartbeat): a deposed worker blocked
+        in ``put`` aborts instead of delivering a stale-generation
+        segment."""
+        self.touch()
+        return self.deposed or self.stop_event.is_set()
+
+    def run(self) -> None:
+        envs = None
+        try:
+            envs = self.env_builder()
+            obs, _ = envs.reset(seed=self.seed)
+            self.protocol.on_reset(self, obs)
+            while not self.stop_event.is_set() and not self.deposed:
+                version_at_start = self._last_version
+                obs, segment, ep_stats, steps = self.protocol.run_segment(
+                    self, envs, obs, self.rollout_steps
+                )
+                self.env_steps += steps
+                if self.stats_sink and ep_stats:
+                    self.stats_sink(ep_stats)
+                if self.deposed or self.stop_event.is_set():
+                    break  # partial/stale work dies with the worker
+                self.traj_queue.put(
+                    segment,
+                    meta={
+                        "version": version_at_start,
+                        "worker": self.worker_id,
+                        "env_steps": steps,
+                        "generation": self.generation,
+                    },
+                    abort=self._push_abort,
+                )
+                self.segments_pushed += 1
+        except (_Deposed, ServiceStopped):
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced to the supervisor
+            if not self.stop_event.is_set():
+                self.error = e
+        finally:
+            if envs is not None:
+                try:
+                    envs.close()
+                except Exception:
+                    pass
+
+
+class _Deposed(RuntimeError):
+    """Raised inside a worker the supervisor gave up on (hang respawn)."""
+
+
+class WorkerSupervisor:
+    """Respawn policy for the env-worker fleet.
+
+    Each worker heartbeats per env step; the supervisor's :meth:`check`
+    (driven from the learner loop — no extra polling thread) deposes
+    workers that died (uncaught exception) or stalled past
+    ``deadline_s`` and respawns them with fresh envs and a bumped
+    generation, up to ``max_restarts`` total.  Deposed workers can never
+    push (generation fencing in :class:`EnvWorker`), so a respawn cannot
+    tear or duplicate trajectories.
+    """
+
+    def __init__(
+        self,
+        spawn: Callable[[int, int], EnvWorker],
+        num_workers: int,
+        *,
+        deadline_s: float = 120.0,
+        max_restarts: int = 3,
+    ):
+        self.spawn = spawn
+        self.deadline_s = float(deadline_s)
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.workers: List[EnvWorker] = [spawn(i, 0) for i in range(num_workers)]
+
+    def start(self) -> None:
+        for w in self.workers:
+            w.start()
+
+    def check(self) -> None:
+        """Depose/respawn wedged or dead workers; raise when the restart
+        budget is exhausted or a worker failed with a non-respawnable
+        error while the budget is empty."""
+        now = time.monotonic()
+        for i, w in enumerate(self.workers):
+            dead = not w.is_alive() and w.error is not None
+            hung = w.is_alive() and (now - w.last_beat) > self.deadline_s
+            if not (dead or hung):
+                continue
+            if self.restarts >= self.max_restarts:
+                raise RuntimeError(
+                    f"env worker {w.worker_id} {'died' if dead else 'hung'} "
+                    f"with the restart budget exhausted "
+                    f"({self.max_restarts})"
+                ) from w.error
+            self.restarts += 1
+            w.deposed = True  # a hung thread exits at its next beat
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "sebulba: env worker %d %s (%s); respawning (restart %d/%d)",
+                w.worker_id,
+                "died" if dead else f"hung for {now - w.last_beat:.1f}s",
+                w.error,
+                self.restarts,
+                self.max_restarts,
+            )
+            from sheeprl_tpu.utils.profiler import RESILIENCE_MONITOR
+
+            RESILIENCE_MONITOR.record_stall(f"sebulba.env_worker[{w.worker_id}]")
+            fresh = self.spawn(w.worker_id, w.generation + 1)
+            self.workers[i] = fresh
+            fresh.start()
+
+    def stop(self, join_timeout: float = 10.0) -> None:
+        for w in self.workers:
+            w.deposed = True
+        for w in self.workers:
+            w.join(join_timeout)
+
+    def alive(self) -> int:
+        return sum(1 for w in self.workers if w.is_alive())
+
+
+class FusedActor(threading.Thread):
+    """Anakin-style on-device rollout shard: one per actor device, for
+    pure-JAX envs.  The whole rollout (env scan + policy + bootstrap) is
+    one compile-once executable over a donated device-resident carry; each
+    finished segment moves device-to-device into the trajectory queue.
+    Steady state performs zero H2D transfers — ``guard`` arms
+    ``jax.transfer_guard_host_to_device("disallow")`` around post-warmup
+    windows to prove it.
+    """
+
+    def __init__(
+        self,
+        index: int,
+        device: Any,
+        rollout_exe: AOTFunction,
+        carry: Any,
+        key: jax.Array,
+        broadcast: ParamBroadcast,
+        traj_queue: TrajQueue,
+        *,
+        segment_meta: Optional[Callable[[Dict[str, Any]], Dict[str, Any]]] = None,
+        stop_event: Optional[threading.Event] = None,
+        stats_sink: Optional[Callable[[Sequence[Tuple[float, int]]], None]] = None,
+        env_steps_per_segment: int = 0,
+        guard: bool = False,
+    ):
+        super().__init__(name=f"sebulba.fused_actor[{index}]", daemon=True)
+        self.index = int(index)
+        self.device = device
+        self.rollout_exe = rollout_exe
+        self._carry = carry
+        self._key = jax.device_put(key, device)
+        self.broadcast = broadcast
+        self.traj_queue = traj_queue
+        self.segment_meta = segment_meta
+        self.stop_event = stop_event or threading.Event()
+        self.stats_sink = stats_sink
+        self.env_steps_per_segment = int(env_steps_per_segment)
+        self.guard = bool(guard)
+        self.error: Optional[BaseException] = None
+        self.segments_pushed = 0
+        self.env_steps = 0
+        self.idle_s = 0.0
+        self.busy_s = 0.0
+
+    def actor_idle_frac(self) -> float:
+        total = self.idle_s + self.busy_s
+        return self.idle_s / total if total > 0 else 0.0
+
+    def cache_sizes(self) -> Dict[int, int]:
+        return {0: self.rollout_exe.cache_size()}
+
+    def run(self) -> None:
+        from sheeprl_tpu.data.device_replay import steady_guard
+
+        try:
+            windows = 0
+            while not self.stop_event.is_set():
+                t0 = time.perf_counter()
+                params, version = self.broadcast.fetch(self.index)
+                with steady_guard(self.guard and windows > 0):
+                    self._carry, segment, last_obs, stats, self._key = self.rollout_exe(
+                        params, self._carry, self._key
+                    )
+                # the dispatch is async: block here so busy/idle measure the
+                # DEVICE's rollout time, not the host enqueue (the
+                # actor_idle_frac gauge is the topology-tuning signal)
+                jax.block_until_ready(self._key)
+                windows += 1
+                t1 = time.perf_counter()
+                self.busy_s += t1 - t0
+                if self.stats_sink is not None:
+                    from sheeprl_tpu.envs.jax.anakin import episode_stats_from_device
+
+                    rets, lens = episode_stats_from_device(stats)
+                    if rets.size:
+                        self.stats_sink(list(zip(rets.tolist(), lens.tolist())))
+                segment = dict(segment)
+                segment.update({f"last_{k}": v for k, v in last_obs.items()})
+                meta = {
+                    "version": version,
+                    "worker": self.index,
+                    "env_steps": self.env_steps_per_segment,
+                    "generation": 0,
+                }
+                if self.stop_event.is_set():
+                    break
+                self.traj_queue.put(segment, meta=meta)
+                self.segments_pushed += 1
+                self.env_steps += self.env_steps_per_segment
+                self.idle_s += time.perf_counter() - t1
+        except ServiceStopped:
+            pass
+        except BaseException as e:  # noqa: BLE001 — surfaced by the runner
+            if not self.stop_event.is_set():
+                self.error = e

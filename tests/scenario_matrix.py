@@ -14,7 +14,9 @@ envs have none), so the printed table documents the coverage honestly.
 
 Extra jax-env cells pin both rollout modes of the on-policy loops: Anakin
 fused (``algo.anakin=auto`` resolves on) AND the JaxToGymAdapter fallback
-(``algo.anakin=False``).
+(``algo.anakin=False``).  The sebulba rows (ISSUE 12) drive the decoupled
+algos through the actor–learner device split on a 2-fake-device
+1-actor/1-learner topology, for ppo/sac × {cpu-gym, jax-env}.
 
 Usage:
   python tests/scenario_matrix.py              # full grid (run_ci stage)
@@ -31,8 +33,13 @@ import time
 import traceback
 from typing import List, Optional, Tuple
 
-# must precede any jax import (conftest-equivalent for a plain script)
+# must precede any jax import (conftest-equivalent for a plain script);
+# the sebulba cells need >= 2 fake devices for a real actor/learner split
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
 # runnable as `python tests/scenario_matrix.py` without an install
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -246,6 +253,33 @@ def build_cells() -> List[Cell]:
                 300.0,
             )
         )
+
+    # ---- sebulba device-split topology (ISSUE 12) ----
+    # cpu-gym cells drive the env-worker + batched-AOT-inference path,
+    # jax cells the fused on-device rollout shards (ppo) and the
+    # jax-through-adapter worker path (sac); every cell is a real 1-actor/
+    # 1-learner device split on 2 fake devices
+    SEBULBA = ["topology=sebulba", "topology.env_workers=2",
+               "fabric.devices=2", "env.num_envs=2"]
+    for fam in ("cpu_gym", "jax"):
+        cells.append(
+            (
+                f"ppo_decoupled×{fam}×sebulba",
+                ["exp=ppo_decoupled", *FAMILY_ENVS[fam]["discrete"], *TINY_ONPOLICY,
+                 "algo.update_epochs=1", *SEBULBA],
+                "",
+                300.0,
+            )
+        )
+        cells.append(
+            (
+                f"sac_decoupled×{fam}×sebulba",
+                ["exp=sac_decoupled", *FAMILY_ENVS[fam]["continuous"], *TINY_SAC,
+                 *SEBULBA, "topology.segment_steps=4"],
+                "",
+                300.0,
+            )
+        )
     return cells
 
 
@@ -278,7 +312,9 @@ def main() -> int:
             continue
         t0 = time.perf_counter()
         try:
-            run([*overrides, *COMMON, f"log_dir={logroot}/{idx}"])
+            # COMMON first: cells may override it (the sebulba cells need a
+            # real 2-device split over COMMON's fabric.devices=1)
+            run([*COMMON, *overrides, f"log_dir={logroot}/{idx}"])
             wall = time.perf_counter() - t0
             if wall > budget:
                 results.append((name, "OVER-BUDGET", wall, f"> {budget:.0f}s"))

@@ -133,6 +133,38 @@ class TestAnakinEndToEnd:
     def test_a2c_multiwindow_guarded_annealed(self, tmp_path):
         run(_anakin_args(tmp_path, "a2c", extra=["algo.anneal_lr=True"]))
 
+    def test_ppo_recurrent_multiwindow_guarded(self, tmp_path):
+        # ISSUE 12 satellite (ROADMAP item 5 remaining): the nn.scan LSTM
+        # policy fused into the rollout scan — recurrent state, prev-action
+        # encoding and episode-start mask all live in the donated carry, so
+        # the armed guard + compile budget prove zero steady-state H2D
+        run(
+            _anakin_args(
+                tmp_path, "ppo_recurrent",
+                extra=[
+                    "env.mask_velocities=False",
+                    "algo.update_epochs=1",
+                    "algo.per_rank_sequence_length=4",
+                    "algo.anneal_lr=True",
+                    "algo.anneal_ent_coef=True",
+                ],
+            )
+        )
+
+    def test_ppo_recurrent_adapter_fallback_when_disabled(self, tmp_path):
+        run(
+            _anakin_args(
+                tmp_path, "ppo_recurrent",
+                extra=[
+                    "env.mask_velocities=False",
+                    "algo.update_epochs=1",
+                    "algo.per_rank_sequence_length=4",
+                    "algo.anakin=False",
+                    "dry_run=True",
+                ],
+            )
+        )
+
     def test_ppo_adapter_fallback_when_disabled(self, tmp_path):
         # algo.anakin=False: same jax env through JaxToGymAdapter +
         # vector-env machinery (guard still green: staging is explicit)

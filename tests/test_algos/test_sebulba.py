@@ -1,0 +1,324 @@
+"""Sebulba actor–learner topology (ISSUE 12): queue semantics, actor
+compile-once, staleness accounting, chaos drills, and end-to-end runs.
+
+The contract under test:
+
+* the trajectory queue is BOUNDED and BLOCKING — a full queue applies
+  backpressure to producers and never drops a segment;
+* torn segments (the ``sebulba.traj_queue`` truncate fault) are rejected
+  at ``put`` and can never reach the learner;
+* actor inference is compile-once: 50 steady dispatch windows reuse ONE
+  executable per ladder rung (``cache_size() == 1``);
+* a killed or hung env worker (the ``sebulba.env_worker`` fault site) is
+  deposed and respawned, and the run completes with no torn trajectories;
+* ppo_decoupled / sac_decoupled train end-to-end through
+  ``topology=sebulba`` on a fake-device split under
+  ``algo.max_recompiles=1``.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.parallel.fabric import Fabric, build_fabric
+from sheeprl_tpu.resilience.faults import FaultPlan, clear_plan, install_plan
+from sheeprl_tpu.sebulba.queues import ObsBlock, QueueFull, TornTrajectory, TrajQueue
+
+
+def _seg(t=4, b=2, version=0):
+    return {
+        "state": np.zeros((t, b, 4), np.float32),
+        "rewards": np.zeros((t, b), np.float32),
+        "last_state": np.zeros((b, 4), np.float32),
+    }
+
+
+class TestTrajQueueSemantics:
+    def _queue(self, capacity=2, steps=4, stage=True):
+        fab = Fabric(devices=2, accelerator="cpu")
+        return TrajQueue(
+            capacity, steps, fab, stage=stage,
+            bootstrap_keys=("last_state",), timeout_s=2.0,
+        )
+
+    def test_backpressure_blocks_producer_and_never_drops(self):
+        q = self._queue(capacity=2)
+        q.put(_seg(), {"version": 0})
+        q.put(_seg(), {"version": 1})
+        assert q.qsize() == 2
+
+        unblocked_at = {}
+
+        def producer():
+            q.put(_seg(), {"version": 2})  # must BLOCK until the learner pops
+            unblocked_at["t"] = time.monotonic()
+
+        t = threading.Thread(target=producer)
+        t.start()
+        time.sleep(0.3)
+        assert "t" not in unblocked_at, "full queue must block, not drop"
+        t0 = time.monotonic()
+        items = q.get_many(2)
+        t.join(5.0)
+        assert unblocked_at["t"] >= t0
+        # nothing was dropped: all three segments arrive, in order
+        items += q.get_many(1)
+        assert [m["version"] for _, m in items] == [0, 1, 2]
+        assert q.total_put == 3
+
+    def test_put_times_out_loudly_when_learner_wedged(self):
+        q = self._queue(capacity=1)
+        q.put(_seg(), {})
+        with pytest.raises(QueueFull):
+            q.put(_seg(), {})  # nobody pops: fail after timeout_s, not hang
+
+    def test_staged_segments_live_on_the_learner_mesh(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        q = TrajQueue(2, 4, fab, stage=True, bootstrap_keys=("last_state",), timeout_s=2.0)
+        q.put(_seg(t=4, b=2), {})
+        (staged, _), = q.get_many(1)
+        leaf = staged["state"]
+        assert isinstance(leaf, jax.Array)
+        # env axis (2 rows) divides the 2-device learner mesh → sharded
+        assert set(leaf.devices()) == set(fab.mesh.devices.flat)
+        assert "data" in str(leaf.sharding.spec)
+
+    def test_torn_segment_rejected_never_enqueued(self):
+        q = self._queue()
+        torn = _seg()
+        torn["state"] = torn["state"][:2]  # tail-torn time axis
+        with pytest.raises(TornTrajectory):
+            q.put(torn, {})
+        assert q.qsize() == 0 and q.torn_rejected == 1
+
+    def test_truncate_fault_at_traj_queue_is_rejected(self):
+        # the sebulba.traj_queue chaos site: a truncate fault tears the
+        # segment in flight — the queue's shape validation must catch it
+        install_plan(FaultPlan.from_specs([
+            {"site": "sebulba.traj_queue", "kind": "truncate", "at": 1},
+        ]))
+        try:
+            q = self._queue()
+            with pytest.raises(TornTrajectory):
+                q.put(_seg(), {})
+            assert q.torn_rejected == 1
+            q.put(_seg(), {})  # the fault fired once; clean puts flow again
+            assert q.qsize() == 1
+        finally:
+            clear_plan()
+
+
+class TestActorCompileOnce:
+    def test_cache_size_one_per_rung_across_50_windows(self):
+        from sheeprl_tpu.parallel.topology import ParamBroadcast
+        from sheeprl_tpu.sebulba.actor import ActorEngine, derive_ladder
+        from sheeprl_tpu.sebulba.queues import ObsQueue
+
+        fab = Fabric(devices=2, accelerator="cpu")
+        actor_dev = fab.devices[0]
+        bc = ParamBroadcast(fab, [actor_dev], max_staleness=8)
+        params = fab.replicate({"w": jnp.zeros((4, 3), jnp.float32)})
+        bc.publish(params, version=0)
+
+        def policy_fn(p, obs, k):
+            k_s, k_next = jax.random.split(k)
+            h = obs["state"] @ p["w"]
+            return {"actions": h[:, :1], "values": h[:, 2]}, k_next
+
+        ladder = derive_ladder(2, 2)  # blocks of 2 rows, up to 2 blocks
+        eng = ActorEngine(
+            0, actor_dev, policy_fn, {"state": ((4,), np.dtype(np.float32))},
+            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), params),
+            ladder, 2, ObsQueue(4), bc, jax.random.PRNGKey(0),
+        )
+        eng.warmup()
+        warm_sizes = dict(eng.cache_sizes())
+        assert all(size == 1 for size in warm_sizes.values())
+        for window in range(50):
+            blocks = [ObsBlock(0, {"state": np.zeros((2, 4), np.float32)}, 2),
+                      ObsBlock(1, {"state": np.ones((2, 4), np.float32)}, 2)]
+            eng._dispatch(blocks)
+            for b in blocks:
+                out = b.wait(1.0)
+                assert out["actions"].shape == (2, 1)
+        # 50 steady windows: every rung still holds exactly ONE executable
+        assert eng.cache_sizes() == warm_sizes
+        assert max(eng.cache_sizes().values()) == 1
+        assert eng.dispatches == 50 and eng.rows_served == 200
+
+    def test_partial_round_pads_to_a_warmed_rung(self):
+        from sheeprl_tpu.parallel.topology import ParamBroadcast
+        from sheeprl_tpu.sebulba.actor import ActorEngine, derive_ladder
+        from sheeprl_tpu.sebulba.queues import ObsQueue
+
+        fab = Fabric(devices=1, accelerator="cpu")
+        bc = ParamBroadcast(fab, [fab.device], max_staleness=8)
+        bc.publish(fab.replicate({"w": jnp.zeros((4, 3), jnp.float32)}), version=0)
+
+        def policy_fn(p, obs, k):
+            k_s, k_next = jax.random.split(k)
+            return {"actions": obs["state"] @ p["w"]}, k_next
+
+        eng = ActorEngine(
+            0, fab.device, policy_fn, {"state": ((4,), np.dtype(np.float32))},
+            {"w": jax.ShapeDtypeStruct((4, 3), jnp.float32)},
+            derive_ladder(2, 4), 2, ObsQueue(8), bc, jax.random.PRNGKey(0),
+        )
+        eng.warmup()
+        # 3 blocks of 2 rows = 6 → padded to the 8-rung (a warmed shape)
+        blocks = [ObsBlock(i, {"state": np.zeros((2, 4), np.float32)}, 2) for i in range(3)]
+        eng._dispatch(blocks)
+        assert eng.rows_served == 6 and eng.rows_padded == 2
+        assert max(eng.cache_sizes().values()) == 1
+
+
+SEBULBA_PPO_ARGS = [
+    "exp=ppo_decoupled",
+    "env=dummy",
+    "env.id=discrete_dummy",
+    "env.max_episode_steps=16",
+    "env.num_envs=4",
+    "env.sync_env=True",
+    "env.capture_video=False",
+    "topology=sebulba",
+    "topology.env_workers=2",
+    "topology.traj_queue_slots=2",
+    "fabric.devices=2",
+    "fabric.accelerator=cpu",
+    "algo.rollout_steps=4",
+    "algo.per_rank_batch_size=8",
+    "algo.update_epochs=1",
+    "algo.mlp_keys.encoder=[state]",
+    "algo.max_recompiles=1",
+    "algo.run_test=False",
+    "checkpoint.every=0",
+    "checkpoint.save_last=False",
+    "buffer.memmap=False",
+    "metric.log_level=1",
+    "metric.log_every=1",
+    "print_config=False",
+]
+
+
+def _run_sebulba_ppo(tmp_path, extra=()):
+    from sheeprl_tpu.sebulba.ppo import run_sebulba
+    from sheeprl_tpu.utils.utils import force_cpu_backend
+
+    force_cpu_backend()
+    cfg = compose([*SEBULBA_PPO_ARGS, f"log_dir={tmp_path}/logs", *extra])
+    fabric = build_fabric(cfg)
+    return run_sebulba(fabric, cfg)
+
+
+class TestSebulbaEndToEnd:
+    def test_ppo_worker_path_trains_and_reports(self, tmp_path):
+        stats = _run_sebulba_ppo(tmp_path, extra=["algo.total_steps=64"])
+        assert stats["updates"] == 4
+        assert stats["env_steps"] == 64
+        assert stats["torn_rejected"] == 0 and stats["worker_restarts"] == 0
+        # every actor executable stayed compile-once
+        for sizes in stats["actor_cache_sizes"]:
+            assert all(s <= 1 for s in sizes.values())
+        assert 0.0 <= stats["actor_idle_frac"] <= 1.0
+        assert 0.0 <= stats["queue_depth_frac"] <= 1.0
+
+    def test_ppo_fused_jax_actor_path(self, tmp_path):
+        from sheeprl_tpu.sebulba.ppo import run_sebulba
+        from sheeprl_tpu.utils.utils import force_cpu_backend
+
+        force_cpu_backend()
+        cfg = compose([
+            "exp=ppo_decoupled", "env=jax_cartpole", "env.num_envs=4",
+            "env.capture_video=False",
+            "topology=sebulba", "topology.actor_devices=2", "topology.traj_queue_slots=2",
+            "fabric.devices=4", "fabric.accelerator=cpu",
+            "algo.rollout_steps=4", "algo.per_rank_batch_size=8",
+            "algo.update_epochs=1", "algo.total_steps=64",
+            "algo.cnn_keys.encoder=[]", "algo.mlp_keys.encoder=[state]",
+            "algo.max_recompiles=1", "algo.run_test=False",
+            "checkpoint.every=0", "checkpoint.save_last=False",
+            "buffer.memmap=False", "buffer.transfer_guard=True",
+            "metric.log_level=0", "print_config=False",
+            f"log_dir={tmp_path}/logs",
+        ])
+        fabric = build_fabric(cfg)
+        stats = run_sebulba(fabric, cfg)
+        assert stats["updates"] == 4
+        # the fused rollout shard is ONE executable per actor device and the
+        # armed transfer guard proved its steady state ships nothing H2D
+        for sizes in stats["actor_cache_sizes"]:
+            assert list(sizes.values()) == [1]
+        # the gate bounds actor-param staleness at dispatch; consumed
+        # segments can add at most the queue's depth on top
+        assert stats["param_staleness_max"] <= cfg.topology.max_staleness + 1
+        assert (
+            stats["traj_staleness_max"]
+            <= cfg.topology.max_staleness + cfg.topology.traj_queue_slots
+        )
+
+    def test_sac_sebulba_device_replay_learner(self, tmp_path):
+        from sheeprl_tpu.sebulba.sac import run_sebulba
+        from sheeprl_tpu.utils.utils import force_cpu_backend
+
+        force_cpu_backend()
+        cfg = compose([
+            "exp=sac_decoupled", "env=dummy", "env.id=continuous_dummy",
+            "env.max_episode_steps=16", "env.num_envs=4", "env.sync_env=True",
+            "env.capture_video=False",
+            "topology=sebulba", "topology.env_workers=2", "topology.segment_steps=4",
+            "fabric.devices=2", "fabric.accelerator=cpu",
+            "algo.per_rank_batch_size=8", "algo.learning_starts=16",
+            "algo.total_steps=96", "algo.replay_ratio=0.5",
+            "algo.mlp_keys.encoder=[state]", "algo.max_recompiles=1",
+            "algo.run_test=False", "checkpoint.every=0", "checkpoint.save_last=False",
+            "buffer.memmap=False", "buffer.size=256", "buffer.device=True",
+            "metric.log_level=1", "metric.log_every=1", "print_config=False",
+            f"log_dir={tmp_path}/logs",
+        ])
+        fabric = build_fabric(cfg)
+        stats = run_sebulba(fabric, cfg)
+        assert stats["updates"] > 0  # training windows actually ran
+        assert stats["env_steps"] == 96
+        assert stats["torn_rejected"] == 0
+
+
+class TestChaosDrills:
+    def test_killed_env_worker_respawned_run_completes(self, tmp_path):
+        # the sebulba.env_worker crash drill: one worker dies mid-rollout;
+        # the supervisor respawns it with fresh envs and the run completes
+        # with the full env-step count and zero torn trajectories
+        install_plan(FaultPlan.from_specs([
+            {"site": "sebulba.env_worker", "kind": "raise", "at": 6, "max_fires": 1},
+        ]))
+        try:
+            stats = _run_sebulba_ppo(tmp_path, extra=["algo.total_steps=96"])
+        finally:
+            clear_plan()
+        assert stats["worker_restarts"] >= 1
+        assert stats["updates"] == 6
+        assert stats["env_steps"] == 96  # nothing torn, nothing lost
+        assert stats["torn_rejected"] == 0
+
+    def test_hung_env_worker_deposed_and_respawned(self, tmp_path):
+        # the hang drill: a worker wedges (sleep past the heartbeat
+        # deadline); the supervisor deposes it — the zombie can never push
+        # again — and a respawn finishes the run
+        install_plan(FaultPlan.from_specs([
+            {"site": "sebulba.env_worker", "kind": "hang", "at": 6,
+             "seconds": 6.0, "max_fires": 1},
+        ]))
+        try:
+            stats = _run_sebulba_ppo(
+                tmp_path,
+                extra=["algo.total_steps=96", "topology.worker_deadline_s=1.0"],
+            )
+        finally:
+            clear_plan()
+        assert stats["worker_restarts"] >= 1
+        assert stats["updates"] == 6
+        assert stats["torn_rejected"] == 0

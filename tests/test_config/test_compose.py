@@ -19,7 +19,7 @@ def base_overrides():
 
 def test_defaults_tree_composes():
     cfg = compose(base_overrides())
-    for group in ("algo", "buffer", "checkpoint", "distribution", "env", "fabric", "metric", "model_manager"):
+    for group in ("algo", "buffer", "checkpoint", "distribution", "env", "fabric", "metric", "model_manager", "topology"):
         assert group in cfg, group
     assert cfg.env.num_envs == 4
     assert cfg.fabric.devices == 1

@@ -81,13 +81,19 @@ def test_player_sync_deferred_semantics():
     psync = PlayerSync(fab, cfg, extract=lambda p: p["actor"])
     p0 = {"actor": jnp.zeros(2)}
     player = psync.init(p0)
+    assert psync.staleness == 0
     # dispatch window 1: deferred -> player unchanged, refresh pending
     p1 = {"actor": jnp.ones(2)}
     player = psync.after_dispatch(p1, player_params=player)
     assert float(np.asarray(player)[0]) == 0.0
+    # the player now acts on init weights while window-1 weights are
+    # pending: one window of (visible) staleness
+    assert psync.staleness == 1
     # window 2 start: the pending params land
     player = psync.before_dispatch(player)
     assert float(np.asarray(player)[0]) == 1.0
+    assert psync.staleness == 0
+    assert psync.metrics()["Player/param_staleness_max"] == 1.0
     # nothing pending: no-op
     assert psync.before_dispatch(player) is player
 
@@ -103,9 +109,14 @@ def test_player_sync_immediate_and_cadence():
     # first completed training window: off-cadence (1 % 2), skipped entirely
     player = psync.after_dispatch({"actor": jnp.ones(2)}, player_params=player)
     assert float(np.asarray(player)[0]) == 0.0
+    assert psync.staleness == 1
     # second window: on-cadence, immediate copy
     player = psync.after_dispatch({"actor": jnp.ones(2)}, player_params=player)
     assert float(np.asarray(player)[0]) == 1.0
+    assert psync.staleness == 0
+    # the immediate-sync staleness bound is sync_every (the off-cadence
+    # window before each refresh) — the metric proves it never exceeded it
+    assert psync.staleness_max <= psync.sync_every
 
 
 def test_player_sync_cadence_counts_training_windows_not_updates():
@@ -128,6 +139,30 @@ def test_player_sync_cadence_counts_training_windows_not_updates():
         if float(np.asarray(player)[0]) == float(window):
             synced += 1
     assert synced == 3  # windows 2, 4, 6
+
+
+def test_player_sync_staleness_bound_deferred_cadence():
+    """ISSUE 12 satellite: the deferred-sync staleness is now observable
+    and must respect its bound — at most ``sync_every`` windows behind
+    (the pending refresh lands one ``before_dispatch`` later) over a long
+    window stream, with the running max reported as a metric."""
+    from sheeprl_tpu.parallel.fabric import PlayerSync
+    from sheeprl_tpu.utils.structured import dotdict
+
+    fab = Fabric(devices=1, accelerator="cpu")
+    sync_every = 3
+    cfg = dotdict({"algo": {"player": {"deferred_sync": True, "sync_every": sync_every, "device": "host"}}})
+    psync = PlayerSync(fab, cfg, extract=lambda p: p)
+    player = psync.init(jnp.zeros(2))
+    for window in range(1, 20):
+        player = psync.before_dispatch(player)
+        assert psync.staleness <= sync_every, (window, psync.staleness)
+        player = psync.after_dispatch(jnp.full(2, float(window)), player_params=player)
+        assert psync.staleness <= sync_every, (window, psync.staleness)
+    m = psync.metrics()
+    assert m["Player/param_staleness_max"] <= sync_every
+    # the bound is tight: the cadence really does let the player lag
+    assert m["Player/param_staleness_max"] >= sync_every - 1
 
 
 def test_player_device_selection():

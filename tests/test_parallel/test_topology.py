@@ -1,0 +1,160 @@
+"""Sebulba device-group topology (parallel/topology.py): split validation,
+topology resolution, and the ParamBroadcast staleness contract."""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.parallel.topology import (
+    DeviceTopology,
+    ParamBroadcast,
+    StalenessExceeded,
+    resolve_topology,
+)
+from sheeprl_tpu.utils.structured import dotdict
+
+
+def _cfg(**topology):
+    return dotdict({"topology": topology})
+
+
+class TestDeviceSplit:
+    def test_default_split_one_actor_rest_learners(self):
+        fab = Fabric(devices=4, accelerator="cpu")
+        topo = DeviceTopology.from_config(fab, _cfg(actor_devices=1))
+        assert topo.num_actors == 1 and topo.num_learners == 3
+        assert set(topo.actor_devices).isdisjoint(topo.learner_devices)
+        assert topo.learner_fabric.world_size == 3
+
+    def test_explicit_two_two_split(self):
+        fab = Fabric(devices=4, accelerator="cpu")
+        topo = DeviceTopology.from_config(fab, _cfg(actor_devices=2, learner_devices=2))
+        assert topo.num_actors == 2 and topo.num_learners == 2
+        # the learner sub-mesh is a 1-D data mesh over exactly its group
+        assert list(topo.learner_fabric.mesh.devices.flat) == topo.learner_devices
+
+    def test_actor_group_swallowing_mesh_rejected(self):
+        fab = Fabric(devices=4, accelerator="cpu")
+        with pytest.raises(ValueError, match="no learner devices"):
+            DeviceTopology.from_config(fab, _cfg(actor_devices=4))
+
+    def test_oversubscribed_split_rejected(self):
+        fab = Fabric(devices=4, accelerator="cpu")
+        with pytest.raises(ValueError, match="exceeds"):
+            DeviceTopology.from_config(fab, _cfg(actor_devices=2, learner_devices=3))
+
+    def test_unassigned_devices_warn(self):
+        fab = Fabric(devices=4, accelerator="cpu")
+        with pytest.warns(RuntimeWarning, match="neither group"):
+            topo = DeviceTopology.from_config(fab, _cfg(actor_devices=1, learner_devices=2))
+        assert topo.num_actors + topo.num_learners == 3
+
+    def test_single_device_degenerates_to_shared(self):
+        fab = Fabric(devices=1, accelerator="cpu")
+        with pytest.warns(RuntimeWarning, match="share the device"):
+            topo = DeviceTopology.from_config(fab, _cfg(actor_devices=1))
+        assert topo.shared and topo.actor_devices == topo.learner_devices
+
+
+class TestResolution:
+    def test_auto_without_sizing_stays_pipelined(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        assert resolve_topology(_cfg(name="auto"), fab) == "pipelined"
+        assert resolve_topology(dotdict({}), fab) == "pipelined"
+
+    def test_auto_with_sizing_upgrades(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        assert resolve_topology(_cfg(name="auto", actor_devices=1), fab) == "sebulba"
+
+    def test_pipelined_pin_wins_over_sizing(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        assert resolve_topology(_cfg(name="pipelined", actor_devices=1), fab) == "pipelined"
+
+    def test_sebulba_forced(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        assert resolve_topology(_cfg(name="sebulba"), fab) == "sebulba"
+
+    def test_sebulba_rejects_model_axis(self):
+        fab = Fabric(devices=4, accelerator="cpu", mesh_shape={"data": 2, "model": 2})
+        with pytest.raises(ValueError, match="model"):
+            resolve_topology(_cfg(name="sebulba"), fab)
+
+
+class TestParamBroadcast:
+    def _bcast(self, fab, **kw):
+        return ParamBroadcast(fab, [fab.devices[0]], **kw)
+
+    def test_publish_fetch_versions_and_d2d_copy(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        bc = ParamBroadcast(fab, [fab.devices[0]], max_staleness=2)
+        params = fab.replicate({"w": jnp.arange(4.0)})
+        v = bc.publish(params, version=0)
+        assert v == 0
+        got, version = bc.fetch(0)
+        assert version == 0
+        np.testing.assert_array_equal(np.asarray(got["w"]), np.arange(4.0))
+        # the actor copy is committed to the actor device, not aliased to
+        # the learner replica (the train step donates the learner buffers)
+        assert set(got["w"].devices()) == {fab.devices[0]}
+        bc.publish(params)  # auto-increment
+        assert bc.version == 1
+        assert bc.staleness(0) == 1
+
+    def test_gate_blocks_until_fetch_within_bound(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        bc = self._bcast(fab, max_staleness=1, gate_timeout_s=30.0)
+        params = fab.replicate({"w": jnp.zeros(2)})
+        bc.publish(params, version=1)
+        bc.publish(params, version=2)
+        bc.publish(params, version=3)  # actor last fetched 0 → 3 behind
+
+        fetched_at = {}
+
+        def late_fetch():
+            time.sleep(0.3)
+            bc.fetch(0)
+            fetched_at["t"] = time.monotonic()
+
+        t = threading.Thread(target=late_fetch)
+        t.start()
+        waited = bc.gate()
+        t.join()
+        assert waited >= 0.2  # the learner really blocked on the actor
+        assert bc.staleness(0) == 0
+
+    def test_gate_times_out_loudly_on_wedged_actor(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        bc = self._bcast(fab, max_staleness=0, gate_timeout_s=0.2)
+        params = fab.replicate({"w": jnp.zeros(2)})
+        bc.publish(params, version=0)  # baseline (seeds the fetch cursors)
+        bc.publish(params, version=1)  # the actor never picks this one up
+        with pytest.raises(StalenessExceeded):
+            bc.gate()
+
+    def test_staleness_metrics_reported(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        bc = self._bcast(fab, max_staleness=4)
+        params = fab.replicate({"w": jnp.zeros(2)})
+        for v in range(0, 4):
+            bc.publish(params, version=v)
+        bc.fetch(0)
+        m = bc.metrics()
+        assert m["Sebulba/param_version"] == 3.0
+        # the baseline publish (v0) seeds the cursors, so the observed lag
+        # is the three updates the actor skipped — NOT the absolute version
+        # (a resumed run publishing v999 first must not report 999)
+        assert m["Sebulba/param_staleness_max"] == 3.0
+
+    def test_resume_baseline_does_not_inflate_staleness(self):
+        fab = Fabric(devices=2, accelerator="cpu")
+        bc = self._bcast(fab, max_staleness=4)
+        params = fab.replicate({"w": jnp.zeros(2)})
+        bc.publish(params, version=999)  # resumed run's first publish
+        _, v = bc.fetch(0)
+        assert v == 999
+        assert bc.metrics()["Sebulba/param_staleness_max"] == 0.0
