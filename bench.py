@@ -37,6 +37,65 @@ BASELINE_CPU_WALL_CLOCK_S = {
 }
 
 
+def _git_sha() -> str | None:
+    """The repo HEAD this bench ran against (best-effort — a payload missing
+    its SHA is a warning sign, not a crash)."""
+    import subprocess
+
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"],
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            capture_output=True, text=True, timeout=10,
+        )
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def _bench_stamp(target: str) -> dict:
+    """Self-describing provenance every mode stamps into its JSON payload:
+    BENCH_*.json files must identify their mode, code revision and
+    host/device inventory without consulting the shell history that
+    produced them."""
+    import multiprocessing
+    import platform
+    import socket
+
+    stamp = {
+        "mode": target,
+        "git_sha": _git_sha(),
+        "generated_at": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "host": {
+            "hostname": socket.gethostname(),
+            "cpus": multiprocessing.cpu_count(),
+            "platform": platform.platform(),
+            "python": platform.python_version(),
+        },
+    }
+    try:
+        import jax
+
+        devs = jax.devices()
+        stamp["devices"] = {
+            "count": len(devs),
+            "platform": devs[0].platform,
+            "kind": getattr(devs[0], "device_kind", ""),
+        }
+        stamp["jax_version"] = jax.__version__
+    except Exception:
+        stamp["devices"] = None
+    return stamp
+
+
+def _phase_frac_sum(breakdown: dict) -> float:
+    """Σ fractions of a span-window breakdown (the ~1.0 acceptance check)."""
+    return round(
+        sum(p["frac"] for p in breakdown["phases"].values()) + breakdown["other_frac"], 6
+    )
+
+
 def bench_dreamer_v3() -> dict:
     import numpy as np
 
@@ -310,14 +369,23 @@ def bench_device_replay() -> dict:
     # pre-split OUTSIDE the guard: eager `keys[i]` slicing stages its index
     # as an implicit device scalar, which the guard (correctly) rejects
     keys = list(jax.random.split(key, iters))
+    # span-instrumented steady window (telemetry/spans.py): the fused
+    # program is on-device sampling + update in ONE executable, so its whole
+    # dispatch is the update.dispatch phase; the breakdown's fractions must
+    # sum to ~1.0 (acceptance)
+    from sheeprl_tpu.telemetry.spans import SPANS, span
+
+    SPANS.roll_window()
     t0 = time.perf_counter()
     with jax.transfer_guard_host_to_device("disallow"):
         for i in range(iters):
-            params, opt_state, counter, metrics = fused(
-                params, opt_state, rb.buffers, rb.cursor, keys[i], counter, n_samples=U
-            )
+            with span("update.dispatch"):
+                params, opt_state, counter, metrics = fused(
+                    params, opt_state, rb.buffers, rb.cursor, keys[i], counter, n_samples=U
+                )
     device_sync((params, metrics))
     elapsed = time.perf_counter() - t0
+    phase_breakdown = SPANS.breakdown()
 
     dev = jax.devices()[0]
     return {
@@ -335,6 +403,8 @@ def bench_device_replay() -> dict:
         "h2d_bytes_per_update": 0.0,
         "replay_hbm_bytes": rb.hbm_bytes,
         "mesh_shape": {k: int(v) for k, v in fabric.mesh.shape.items()},
+        "phase_breakdown": phase_breakdown,
+        "phase_frac_sum": _phase_frac_sum(phase_breakdown),
     }
 
 
@@ -705,12 +775,17 @@ def bench_env() -> dict:
     s.block_until_ready()
     first_call_s = time.perf_counter() - t_first
     keys = list(jax.random.split(jax.random.PRNGKey(2), fused_iters))
+    from sheeprl_tpu.telemetry.spans import SPANS, span
+
+    SPANS.roll_window()
     t0 = time.perf_counter()
     with jax.transfer_guard_host_to_device("disallow"):
         for i in range(fused_iters):
-            state, s = fused_rollout(state, keys[i])
+            with span("rollout"):
+                state, s = fused_rollout(state, keys[i])
     s.block_until_ready()
     fused_rate = steps * n_fused * fused_iters / (time.perf_counter() - t0)
+    phase_breakdown = SPANS.breakdown()
 
     dev = jax.devices()[0]
     return {
@@ -731,6 +806,8 @@ def bench_env() -> dict:
         "first_call_s": round(first_call_s, 3),
         # guard completion == zero H2D inside the fused steady loop
         "h2d_bytes_per_update": 0.0,
+        "phase_breakdown": phase_breakdown,
+        "phase_frac_sum": _phase_frac_sum(phase_breakdown),
     }
 
 
@@ -852,6 +929,11 @@ def bench_sebulba() -> dict:
         "learner_devices": n_devices - n_actors if n_devices > 1 else 1,
         "worker_restarts": stats["worker_restarts"],
         "torn_rejected": stats["torn_rejected"],
+        # step-phase breakdown of the learner window (telemetry/spans.py):
+        # queue.wait vs rollout vs update.dispatch vs param.broadcast
+        # fractions — the tuning signal for traj_queue_slots/max_staleness
+        "phase_breakdown": stats["phase_breakdown"],
+        "phase_frac_sum": _phase_frac_sum(stats["phase_breakdown"]),
         # ISSUE 12 acceptance gates: compile-once actor inference across the
         # steady windows under the armed guard, and beating the adapter path
         "cache_size_one": cache_ok,
@@ -984,6 +1066,129 @@ def bench_fault_overhead() -> dict:
     }
 
 
+def bench_telemetry_overhead() -> dict:
+    """Zero-overhead gate for the telemetry subsystem (docs/telemetry.md).
+
+    Default-on telemetry (span push/pop per phase, the recorder's span-edge
+    events, the tracer tick) must cost <``BENCH_TELEMETRY_TOL`` (default
+    2%) of steady-state DreamerV3 updates/s — measured exactly like the
+    fault-injection gate: INTERLEAVED A/B windows over the same compiled
+    executable, min-of-N per arm (host noise is one-sided), directional
+    (only a slowdown of the instrumented arm can fail).  The instrumented
+    arm pays the real per-update span load: a top-level rollout span, a
+    top-level update.dispatch span (which also ticks the trace scheduler)
+    and a nested queue-wait span.
+
+    ``gate_failed: true`` in the payload (and a nonzero exit) on violation.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.telemetry.spans import SPANS, span
+    from sheeprl_tpu.utils.utils import device_sync
+
+    size = os.environ.get("BENCH_SIZE", "XS")
+    L = int(os.environ.get("BENCH_L", 8))
+    B = int(os.environ.get("BENCH_B", 4))
+    U = int(os.environ.get("BENCH_U", 2))
+    samples = int(os.environ.get("BENCH_TELEMETRY_SAMPLES", 12))
+    tol = float(os.environ.get("BENCH_TELEMETRY_TOL", 0.02))
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"algo=dreamer_v3_{size}",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.per_rank_batch_size={B}",
+            f"algo.per_rank_sequence_length={L}",
+        ]
+    )
+    fabric = build_fabric(cfg)
+    rng = np.random.default_rng(0)
+    block = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(np.uint8)),
+        "actions": jnp.asarray(rng.integers(0, 2, (U, L, B, 4)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(U, L, B)).astype(np.float32)),
+        "terminated": jnp.zeros((U, L, B), jnp.float32),
+        "is_first": jnp.zeros((U, L, B), jnp.float32),
+    }
+    train_phase, params, opt_state = _build_dv3_train_phase(fabric, cfg)
+    block = fabric.shard_batch(block, axis=2)
+    key = jax.random.PRNGKey(0)
+
+    # warm up once; both arms reuse this one executable
+    params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(0))
+    device_sync((params, metrics))
+
+    step = 0
+
+    def one_dispatch(instrumented: bool):
+        nonlocal params, opt_state, step
+        t0 = time.perf_counter()
+        if instrumented:
+            # the real per-update span load of an instrumented train loop:
+            # rollout + nested queue wait, then the train dispatch (whose
+            # top-level span also ticks the trace scheduler)
+            with span("rollout"):
+                with span("queue.wait"):
+                    pass
+            with span("update.dispatch"):
+                params, opt_state, metrics = train_phase(
+                    params, opt_state, block, key, jnp.int32(step)
+                )
+        else:
+            params, opt_state, metrics = train_phase(
+                params, opt_state, block, key, jnp.int32(step)
+            )
+        device_sync((params, metrics))
+        step += 1
+        return time.perf_counter() - t0
+
+    one_dispatch(False)  # discard one warm-in dispatch (caches, allocator)
+
+    # interleaved A/B, min-of-N estimator — the fault_overhead pattern:
+    # noise on a shared host only ever SLOWS a dispatch, so each arm's
+    # minimum is a tight attainable-latency estimate, and alternating
+    # arms keeps drift from systematically favoring one
+    baseline, instrumented = [], []
+    for s in range(2 * samples):
+        if s % 2 == 0:
+            SPANS.enabled = False
+            baseline.append(one_dispatch(False))
+        else:
+            SPANS.enabled = True
+            instrumented.append(one_dispatch(True))
+    SPANS.enabled = True
+    phase_breakdown = SPANS.breakdown()
+
+    base = U / min(baseline)
+    instr = U / min(instrumented)
+    # directional: only a SLOWDOWN of the instrumented arm is a regression
+    overhead = max(0.0, (base - instr) / base)
+    gate_failed = overhead >= tol
+    return {
+        "metric": (
+            f"telemetry_span_overhead "
+            f"(dreamer_v3_{size} B={B} L={L} U={U}, {samples}x interleaved A/B, min-estimator)"
+        ),
+        "value": round(overhead * 100, 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "steady_updates_per_s_disabled": round(base, 4),
+        "steady_updates_per_s_instrumented": round(instr, 4),
+        "tolerance_pct": tol * 100,
+        "phase_breakdown": phase_breakdown,
+        "phase_frac_sum": _phase_frac_sum(phase_breakdown),
+        "gate_failed": gate_failed,
+    }
+
+
 def _run_bench() -> dict:
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
     if target == "serve":
@@ -992,6 +1197,8 @@ def _run_bench() -> dict:
         return bench_device_replay()
     if target == "fault_overhead":
         return bench_fault_overhead()
+    if target == "telemetry_overhead":
+        return bench_telemetry_overhead()
     if target == "env":
         return bench_env()
     if target == "sebulba":
@@ -1118,6 +1325,10 @@ if __name__ == "__main__":
             # the TPU plugin overrides the env var; jax.config wins
             force_cpu_backend()
         result = _run_bench()
+        # every mode's payload is self-describing: mode, git SHA and
+        # host/device inventory ride along (BENCH_*.json archaeology must
+        # not need the shell history that produced the file)
+        result.update(_bench_stamp(os.environ.get("BENCH_TARGET", "dreamer_v3")))
         print(json.dumps(result))
         if result.get("gate_failed"):
             # the fault-overhead gate is an ASSERTION: empty-plan steady

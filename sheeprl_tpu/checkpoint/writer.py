@@ -124,7 +124,13 @@ class AsyncCheckpointWriter:
             if self._watchdog is not None:
                 self._watchdog.arm()
             try:
-                nbytes = self._run_job(job)
+                # writer-thread span: snapshot cost shows up in the phase
+                # breakdown as concurrent ckpt.snapshot time, distinct from
+                # the learner's critical path (telemetry/spans.py)
+                from sheeprl_tpu.telemetry.spans import span
+
+                with span("ckpt.snapshot"):
+                    nbytes = self._run_job(job)
                 CHECKPOINT_MONITOR.record_save(
                     seconds=time.perf_counter() - t0,
                     nbytes=int(nbytes or 0),

@@ -207,6 +207,14 @@ def run(argv: Optional[List[str]] = None) -> None:
     from sheeprl_tpu.checkpoint import PREEMPTION_GUARD
 
     PREEMPTION_GUARD.clear_latch()
+    # same for the telemetry hub and flight recorder: a logger/step left
+    # over from a previous run in this interpreter must not receive THIS
+    # run's final flush, and a postmortem written by this run must hold
+    # this run's events — not a previous drill's fault trail
+    from sheeprl_tpu import telemetry
+
+    telemetry.HUB.reset()
+    telemetry.RECORDER.clear()
     cfg = compose(argv)
     # arm (or explicitly clear) the fault-injection plan before anything
     # else touches envs/checkpoints — SHEEPRL_FAULT_PLAN wins over the group
@@ -225,7 +233,28 @@ def run(argv: Optional[List[str]] = None) -> None:
 
     if cfg.get("print_config", True):
         print_config(cfg)
-    run_algorithm(cfg)
+    try:
+        run_algorithm(cfg)
+    except BaseException as e:
+        # every abnormal exit leaves evidence: the flight recorder dumps
+        # its ring (injected faults, stalls, restarts, span edges, the
+        # crash itself) as postmortem.json under the run dir
+        telemetry.RECORDER.record("crash", error=f"{type(e).__name__}: {e}")
+        telemetry.RECORDER.dump("exception")
+        raise
+    finally:
+        if PREEMPTION_GUARD.requested():
+            telemetry.RECORDER.record(
+                "preemption", signal=PREEMPTION_GUARD.signal_name
+            )
+            telemetry.RECORDER.dump("preemption")
+        # metrics buffered in the monitors since the last log interval
+        # would otherwise be lost on any non-interval exit (exception,
+        # preemption latch, dry-run) — land the final window through the
+        # attached logger, then stop trace windows / the introspection
+        # server.  Best-effort: telemetry never masks the real exception.
+        telemetry.HUB.final_flush()
+        telemetry.shutdown_run()
 
 
 def evaluation(argv: Optional[List[str]] = None) -> None:

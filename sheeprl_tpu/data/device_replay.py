@@ -498,20 +498,25 @@ class DeviceReplay:
             [(self._pos_h[e] + np.arange(steps)) % self._capacity for e in env_sel],
             axis=1,
         ).astype(np.int32)  # (T, K)
-        scatter, _, advance = self._ops()
-        t_dev = self._put(t_idx)
-        e_dev = self._put(env_sel.astype(np.int32))
-        for k, v in data.items():
-            rows = self._put(np.asarray(v)[-steps:])
-            self._buf[k] = scatter(self._buf[k], rows, t_dev, e_dev)
-        mask = np.zeros(self._n_envs, bool)
-        mask[env_sel] = True
-        self.cursor["pos"], self.cursor["filled"] = advance(
-            self.cursor["pos"],
-            self.cursor["filled"],
-            self._put(np.int32(steps)),
-            self._put(mask),
-        )
+        # host→ring staging is its own telemetry phase (replay.write): the
+        # H2D stage + donated scatter dispatch the rollout pays per append
+        from sheeprl_tpu.telemetry.spans import span
+
+        with span("replay.write"):
+            scatter, _, advance = self._ops()
+            t_dev = self._put(t_idx)
+            e_dev = self._put(env_sel.astype(np.int32))
+            for k, v in data.items():
+                rows = self._put(np.asarray(v)[-steps:])
+                self._buf[k] = scatter(self._buf[k], rows, t_dev, e_dev)
+            mask = np.zeros(self._n_envs, bool)
+            mask[env_sel] = True
+            self.cursor["pos"], self.cursor["filled"] = advance(
+                self.cursor["pos"],
+                self.cursor["filled"],
+                self._put(np.int32(steps)),
+                self._put(mask),
+            )
         self._pos_h[env_sel] = (self._pos_h[env_sel] + steps) % self._capacity
         self._filled_h[env_sel] = np.minimum(self._filled_h[env_sel] + steps, self._capacity)
 

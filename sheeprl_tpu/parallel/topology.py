@@ -256,8 +256,11 @@ class ParamBroadcast:
         the learner right after its (async-dispatched) update — the D2D
         copies are enqueued behind the update, so by the time an actor
         dispatch reads them the device has finished both."""
-        sub = self.extract(params)
-        copies = [self.fabric.copy_to(sub, d) for d in self.actor_devices]
+        from sheeprl_tpu.telemetry.spans import span
+
+        with span("param.broadcast"):
+            sub = self.extract(params)
+            copies = [self.fabric.copy_to(sub, d) for d in self.actor_devices]
         with self._lock:
             first = self.publishes == 0
             self._version = int(version) if version is not None else self._version + 1

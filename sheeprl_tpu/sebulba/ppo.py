@@ -368,13 +368,24 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     t_start = time.perf_counter()
 
     # ---------------- run ----------------------------------------------------
-    broadcast.publish(params, version=start_iter - 1)
-    for eng in engines:
-        eng.start()
-    if supervisor is not None:
-        supervisor.start()
+    # queue/broadcast counters become live hub sources for the duration of
+    # the run (scrapeable via /metrics mid-run, not just at log intervals);
+    # a fresh span window makes the end-of-run phase breakdown cover the
+    # training loop, not agent construction/compilation
+    from sheeprl_tpu.telemetry import HUB, SPANS
+
+    HUB.register("sebulba.traj_queue", traj_queue.metrics)
+    HUB.register("sebulba.broadcast", broadcast.metrics)
+    SPANS.roll_window()
 
     try:
+        # inside the try: the first publish crosses fabric.copy_to (a
+        # fault-injection site) — a throw here must still unregister
+        broadcast.publish(params, version=start_iter - 1)
+        for eng in engines:
+            eng.start()
+        if supervisor is not None:
+            supervisor.start()
         for update in range(start_iter, total_iters + 1):
             with timer("Time/env_interaction_time"):
                 items = drain_segments(traj_queue, n_producers, engines, supervisor)
@@ -458,6 +469,11 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
                 fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
                 break
     finally:
+        # unregister on EVERY exit (timeout/staleness/engine errors
+        # included): a leaked source would pin the dead run's queue ring
+        # and report its stale gauges into the next run's flushes
+        HUB.unregister("sebulba.traj_queue")
+        HUB.unregister("sebulba.broadcast")
         shutdown(stop_event, traj_queue, obs_queue, engines, supervisor)
 
     run_stats = collect_run_stats(

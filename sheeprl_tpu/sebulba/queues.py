@@ -277,6 +277,14 @@ class TrajQueue:
                 self._not_empty.wait(min(remaining, 0.2))
             self.get_wait_s += time.perf_counter() - t0
             take = min(n, len(self._items))
+            # per-pop queue-depth sample for the flight recorder (bounded
+            # ring, learner-update cadence) — postmortems show whether the
+            # queue was starved or backed up when the run died
+            from sheeprl_tpu.telemetry.recorder import RECORDER
+
+            RECORDER.record(
+                "queue.depth", depth=len(self._items), frac=round(self._meter.frac(), 4)
+            )
             out, self._items = self._items[:take], self._items[take:]
             self._meter.move(-take)
             self._not_full.notify_all()

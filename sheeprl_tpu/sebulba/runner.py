@@ -12,6 +12,7 @@ import numpy as np
 
 from sheeprl_tpu.sebulba.actor import EnvWorker, WorkerSupervisor
 from sheeprl_tpu.sebulba.queues import ObsQueue, ServiceStopped, TrajQueue
+from sheeprl_tpu.telemetry.spans import SPANS, span
 from sheeprl_tpu.utils.env import make_env, vectorize
 
 
@@ -114,20 +115,24 @@ def drain_segments(
     queue's overall ``timeout_s`` so a wedged fused actor (which has no
     supervisor) fails the run loudly instead of hanging it."""
     deadline = time.monotonic() + traj_queue.timeout_s
-    while True:
-        try:
-            return traj_queue.get_many(n, timeout_s=5.0)
-        except TimeoutError:
-            for eng in engines:
-                if eng.error is not None:
-                    raise eng.error
-            if supervisor is not None:
-                supervisor.check()
-            if time.monotonic() > deadline:
-                raise TimeoutError(
-                    f"trajectory queue produced < {n} segments in "
-                    f"{traj_queue.timeout_s}s — actors wedged?"
-                )
+    # the learner's queue wait is ITS OWN phase (telemetry/spans.py): time
+    # spent here is actor starvation, not rollout work — the queue.wait
+    # fraction of the phase breakdown is what traj_queue_slots tuning reads
+    with span("queue.wait"):
+        while True:
+            try:
+                return traj_queue.get_many(n, timeout_s=5.0)
+            except TimeoutError:
+                for eng in engines:
+                    if eng.error is not None:
+                        raise eng.error
+                if supervisor is not None:
+                    supervisor.check()
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"trajectory queue produced < {n} segments in "
+                        f"{traj_queue.timeout_s}s — actors wedged?"
+                    )
 
 
 def shutdown(
@@ -171,6 +176,10 @@ def collect_run_stats(
 ) -> Dict[str, Any]:
     """The ``bench.py --mode sebulba`` stats contract, assembled once."""
     return {
+        # the current span window's phase-breakdown fractions (queue.wait /
+        # rollout / update.dispatch / param.broadcast / other, summing to
+        # ~1.0) — bench.py republishes this as its `phase_breakdown` block
+        "phase_breakdown": SPANS.breakdown(),
         "topology": topo.describe(),
         "updates": int(updates),
         "wall_s": wall_s,

@@ -330,12 +330,21 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     t_start = time.perf_counter()
 
     # ---------------- run ----------------------------------------------------
-    broadcast.publish(params, version=windows)
-    for eng in engines:
-        eng.start()
-    supervisor.start()
+    # live hub sources for the run + a fresh span window so the end-of-run
+    # phase breakdown covers the training loop (see sebulba/ppo.py)
+    from sheeprl_tpu.telemetry import HUB, SPANS
+
+    HUB.register("sebulba.traj_queue", traj_queue.metrics)
+    HUB.register("sebulba.broadcast", broadcast.metrics)
+    SPANS.roll_window()
 
     try:
+        # inside the try: the first publish crosses fabric.copy_to (a
+        # fault-injection site) — a throw here must still unregister
+        broadcast.publish(params, version=windows)
+        for eng in engines:
+            eng.start()
+        supervisor.start()
         for rnd in range(start_round, total_rounds + 1):
             with timer("Time/env_interaction_time"):
                 items = drain_segments(traj_queue, num_workers, engines, supervisor)
@@ -438,6 +447,10 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
                 fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
                 break
     finally:
+        # unregister on EVERY exit — a leaked source would pin the dead
+        # run's queue ring and report stale gauges into the next run
+        HUB.unregister("sebulba.traj_queue")
+        HUB.unregister("sebulba.broadcast")
         shutdown(stop_event, traj_queue, obs_queue, engines, supervisor)
 
     run_stats = collect_run_stats(

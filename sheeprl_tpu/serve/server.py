@@ -174,6 +174,23 @@ def _make_handler(service: Any):
                     )
                 elif self.path == "/v1/stats":
                     self._reply(200, service.stats())
+                elif self.path == "/metrics":
+                    # the training-side introspection contract on the serve
+                    # surface: every telemetry-hub metric (Serve/* included —
+                    # the service registers itself on start) in Prometheus
+                    # text exposition format
+                    from sheeprl_tpu.telemetry import (
+                        HUB,
+                        PROMETHEUS_CONTENT_TYPE,
+                        prometheus_text,
+                    )
+
+                    body = prometheus_text(HUB.collect()).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", PROMETHEUS_CONTENT_TYPE)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
                 else:
                     self._reply(404, {"error": f"unknown path {self.path}"})
             except BrokenPipeError:

@@ -125,6 +125,12 @@ class PolicyService:
         if self.watcher is not None and self._watch:
             self.watcher.start()
         self._started = True
+        # export the serving stats through the telemetry hub: /v1/stats'
+        # numbers (and the server's /metrics Prometheus view) come from the
+        # same registration API every other subsystem uses
+        from sheeprl_tpu.telemetry import HUB
+
+        HUB.register("serve", self.hub_metrics)
         return self
 
     def stop(self, drain: bool = True, timeout: float = 30.0) -> None:
@@ -141,6 +147,9 @@ class PolicyService:
                 req.fail(ServiceStopped("service stopped before dispatch"))
         if self.watcher is not None:
             self.watcher.stop()
+        from sheeprl_tpu.telemetry import HUB
+
+        HUB.unregister("serve")
         self._started = False
 
     def __enter__(self) -> "PolicyService":
@@ -307,6 +316,22 @@ class PolicyService:
             "sessions": len(self._sessions),
         }
         out.update(self.latency.percentiles((50, 99)))
+        return out
+
+    def hub_metrics(self) -> Dict[str, float]:
+        """The numeric subset of :meth:`stats` as ``Serve/*`` hub metrics
+        (the telemetry-hub source registered by :meth:`start`)."""
+        s = self.stats()
+        out: Dict[str, float] = {}
+        for key in (
+            "served", "batches", "errors", "pending", "avg_batch",
+            "padded_frac", "generation", "checkpoint_step", "reloads",
+            "quarantined", "sessions", "p50_ms", "p99_ms",
+        ):
+            value = s.get(key)
+            if isinstance(value, (int, float)) and not isinstance(value, bool):
+                out[f"Serve/{key}"] = float(value)
+        out["Serve/degraded"] = 1.0 if s.get("degraded") else 0.0
         return out
 
 

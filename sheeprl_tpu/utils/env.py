@@ -358,6 +358,15 @@ class StepDeadlineVectorEnv:
         while self._restart_times and now - self._restart_times[0] > self._window:
             self._restart_times.popleft()
         if len(self._restart_times) >= self._max_restarts:
+            # watchdog teardown exhausted its budget: this kills the run, so
+            # leave the evidence NOW — the stall/restart event trail plus
+            # this giveup — even if something swallows the raise upstream
+            from sheeprl_tpu.telemetry.recorder import RECORDER
+
+            RECORDER.record(
+                "watchdog.giveup", reason=reason, restarts=len(self._restart_times)
+            )
+            RECORDER.dump("watchdog")
             raise RuntimeError(
                 f"vector env wedged {len(self._restart_times) + 1} times within "
                 f"{self._window}s ({reason}); giving up"

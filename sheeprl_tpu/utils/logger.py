@@ -131,7 +131,19 @@ def get_log_dir(fabric: Any, root_dir: str, run_name: str, base: str = "logs/run
 
 
 def get_logger(fabric: Any, cfg: Any, log_dir: str) -> Optional[Any]:
-    """Instantiate the configured logger on process 0 only."""
+    """Instantiate the configured logger on process 0 only.
+
+    Also the central telemetry arm-point: every training loop (all 12
+    algos, the Sebulba drivers, evaluation) constructs its logger here, so
+    ``telemetry.setup_run`` — spans, trace windows, the flight recorder's
+    run directory, the introspection endpoint — needs no per-loop wiring.
+    The created logger is attached to the hub so the ``finally`` path of
+    ``cli.run`` can land the last metric window after a crash."""
+    from sheeprl_tpu import telemetry
+
+    telemetry.setup_run(
+        cfg, log_dir, rank=fabric.global_rank if fabric is not None else 0
+    )
     if fabric is not None and fabric.global_rank != 0:
         return None
     if getattr(cfg.metric, "log_level", 1) <= 0:
@@ -139,17 +151,20 @@ def get_logger(fabric: Any, cfg: Any, log_dir: str) -> Optional[Any]:
     kind = cfg.metric.logger.kind if "logger" in cfg.metric else "tensorboard"
     if kind == "tensorboard":
         try:
-            return TensorBoardLogger(log_dir)
+            logger = TensorBoardLogger(log_dir)
         except Exception:
-            return CSVLogger(log_dir)
-    if kind == "csv":
-        return CSVLogger(log_dir)
-    if kind == "mlflow":
+            logger = CSVLogger(log_dir)
+    elif kind == "csv":
+        logger = CSVLogger(log_dir)
+    elif kind == "mlflow":
         lcfg = cfg.metric.logger
-        return MLflowLogger(
+        logger = MLflowLogger(
             log_dir,
             experiment_name=lcfg.get("experiment_name") or cfg.get("exp_name", "default"),
             tracking_uri=lcfg.get("tracking_uri"),
             run_name=lcfg.get("run_name"),
         )
-    raise ValueError(f"Unknown logger kind: {kind}")
+    else:
+        raise ValueError(f"Unknown logger kind: {kind}")
+    telemetry.HUB.attach_logger(logger)
+    return logger

@@ -162,26 +162,18 @@ def flush_metrics(
     if extra_metrics:
         metrics.update(extra_metrics)
     metrics.update(times)
-    # compile-once layer accounting: cumulative executable count + compile
-    # seconds (utils/profiler.py COMPILE_MONITOR).  A count that keeps
-    # growing after warm-up IS the recompile pathology the detector exists
-    # for — surfacing it in the normal metric stream makes it visible in
-    # TensorBoard without a debugger attached.
-    from sheeprl_tpu.utils.profiler import (
-        CHECKPOINT_MONITOR,
-        COMPILE_MONITOR,
-        RESILIENCE_MONITOR,
-    )
+    # telemetry hub flush: every registered source in one call — the
+    # compile-once accounting (Compile/*: a count that keeps growing after
+    # warm-up IS the recompile pathology), checkpoint writer accounting
+    # (Checkpoint/*: async-save cost), resilience accounting (Resilience/*:
+    # empty unless something actually happened), the span tracker's
+    # per-window phase-breakdown fractions (Phase/*), and anything a run
+    # registered (Sebulba queues, the policy service).  roll=True closes
+    # the span window — the metric interval IS the phase window.
+    from sheeprl_tpu.telemetry.hub import HUB
 
-    metrics.update(COMPILE_MONITOR.compile_metrics())
-    # checkpointing subsystem accounting (sheeprl_tpu/checkpoint): last save
-    # wall time + bytes, recorded by the (possibly background) writer —
-    # surfaces async-save cost in the normal metric stream
-    metrics.update(CHECKPOINT_MONITOR.metrics())
-    # resilience accounting (sheeprl_tpu/resilience): retries, watchdog
-    # stalls, env restarts, breaker opens, injected faults — empty (no
-    # Resilience/* keys at all) unless something actually happened
-    metrics.update(RESILIENCE_MONITOR.metrics())
+    metrics.update(HUB.flush(roll=True))
+    HUB.note_step(policy_step)
     if logger is not None and metrics:
         logger.log_metrics(metrics, policy_step)
     return policy_step

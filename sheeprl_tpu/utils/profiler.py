@@ -1,328 +1,36 @@
-"""Profiler gating for train loops + compile accounting.
+"""Profiler gating for train loops + monitor shims.
 
-The reference has no profiler integration (SURVEY.md §5.1 — named timers
-only); on TPU a ``jax.profiler`` trace is the difference between guessing
-and knowing where the step time goes (MXU utilization, HBM stalls, host
-H2D gaps), so the TPU framework makes it a config switch:
+The process-global monitors that historically lived here — the compile
+accounting of the compile-once layer (PR 1), the checkpoint writer
+accounting (PR 2) and the resilience accounting (PR 8) — are owned by the
+**telemetry subsystem** since PR 13 (``sheeprl_tpu/telemetry/monitors.py``,
+registered with the :data:`~sheeprl_tpu.telemetry.hub.HUB` behind one
+flush contract; see docs/telemetry.md).  The names below are thin shims
+over the SAME objects, kept so every existing call site
+(``from sheeprl_tpu.utils.profiler import COMPILE_MONITOR``) and test
+keeps working unchanged.
 
-    metric.profiler.enabled=True metric.profiler.start_update=10 \
-    metric.profiler.stop_update=12
-
-captures updates [start, stop) into ``<log_dir>/profiler`` (viewable with
-TensorBoard's profile plugin / xprof).  Updates before ``start_update``
-are skipped so compilation and warm-up never pollute the trace.
-
-This module also hosts the **recompile detector** of the compile-once
-execution layer (``parallel/compile.py``): every AOT lowering/compilation
-performed through ``fabric.compile`` records a (function, abstract
-signature) event into the process-global :data:`COMPILE_MONITOR`.  A
-recompile — any compile of a named function beyond its first — means the
-caller fed a new shape/dtype/sharding signature into a supposedly
-compile-once program (last-batch remainders, framestack variants, drifting
-scalar dtypes...).  ``max_recompiles`` (per function, or globally via
-``SHEEPRL_MAX_RECOMPILES``) turns that from a silent multi-minute TPU stall
-into a hard :class:`RecompileLimitExceeded` with the full signature history
-attached.
+This module keeps :class:`ProfilerGate` — the config-armed
+``jax.profiler`` window around a fixed update range
+(``metric.profiler.start_update``/``stop_update``).  For *on-demand*
+windows on a live run (update numbers, ``SHEEPRL_TRACE_AT``, SIGUSR1),
+use ``telemetry.trace_at`` — ``sheeprl_tpu/telemetry/tracer.py``.
 """
 
 from __future__ import annotations
 
 import os
-import threading
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any
 
-
-class RecompileLimitExceeded(RuntimeError):
-    """A compile-once function exceeded its allowed recompile budget."""
-
-
-class CompileMonitor:
-    """Process-global per-function compile counter + abstract-signature log.
-
-    ``count(name)`` is the number of executables built for ``name`` — the
-    first compile is expected; every further one is a *recompile* caused by
-    a new abstract signature.  The ``max_recompiles`` budget itself is
-    enforced per-``AOTFunction`` instance (see ``parallel/compile.py``),
-    which raises :class:`RecompileLimitExceeded`; this monitor is the
-    process-wide aggregate view (metrics, dryrun stage summaries).
-    """
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self._stats: Dict[str, Dict[str, Any]] = {}
-
-    # -- recording (called by parallel.compile.AOTFunction) -----------------
-    def begin(self, name: str, signature: Any) -> None:
-        """Count one compile of ``name`` in the process-global accounting.
-
-        Pure bookkeeping: the ``max_recompiles`` budget is enforced
-        per-:class:`~sheeprl_tpu.parallel.compile.AOTFunction` *instance*
-        (each instance IS one compile-once program).  The global per-name
-        count would otherwise aggregate across unrelated instances that
-        happen to share a name — e.g. every run constructed in the same
-        test process — and trip the budget for compiles the current
-        program never performed.
-        """
-        with self._lock:
-            st = self._stats.setdefault(
-                name, {"count": 0, "seconds": 0.0, "signatures": []}
-            )
-            st["count"] += 1
-            st["signatures"].append(str(signature))
-
-    def abort(self, name: str, signature: Any = None) -> None:
-        """Roll back one ``begin`` for ``name``: the compile failed, so no
-        executable exists — counters must reflect programs actually built.
-        When ``signature`` is given, the MATCHING history entry (searched
-        from the end) is removed rather than blindly the last one, since two
-        signatures of one function can compile concurrently."""
-        with self._lock:
-            st = self._stats.get(name)
-            if st is None or st["count"] <= 0:
-                return
-            st["count"] -= 1
-            if not st["signatures"]:
-                return
-            if signature is None:
-                st["signatures"].pop()
-                return
-            sig_str = str(signature)
-            for i in range(len(st["signatures"]) - 1, -1, -1):
-                if st["signatures"][i] == sig_str:
-                    del st["signatures"][i]
-                    break
-
-    def end(self, name: str, seconds: float) -> None:
-        with self._lock:
-            st = self._stats.get(name)
-            if st is not None:
-                st["seconds"] += float(seconds)
-
-    @staticmethod
-    def default_limit() -> Optional[int]:
-        raw = os.environ.get("SHEEPRL_MAX_RECOMPILES", "").strip()
-        return int(raw) if raw else None
-
-    # -- queries -------------------------------------------------------------
-    def count(self, name: str) -> int:
-        with self._lock:
-            return int(self._stats.get(name, {}).get("count", 0))
-
-    def signatures(self, name: str) -> List[str]:
-        with self._lock:
-            return list(self._stats.get(name, {}).get("signatures", ()))
-
-    def totals(self) -> Tuple[int, float]:
-        """(total executables compiled, total compile seconds)."""
-        with self._lock:
-            return (
-                sum(st["count"] for st in self._stats.values()),
-                sum(st["seconds"] for st in self._stats.values()),
-            )
-
-    def summary(self) -> Dict[str, Dict[str, Any]]:
-        with self._lock:
-            return {
-                name: {
-                    "count": st["count"],
-                    "seconds": round(st["seconds"], 3),
-                    "signatures": list(st["signatures"]),
-                }
-                for name, st in self._stats.items()
-            }
-
-    def delta_report(self, mark: Tuple[int, float]) -> str:
-        """One human line of what compiled since ``mark`` (from totals())."""
-        count, seconds = self.totals()
-        return f"{count - mark[0]} executables / {seconds - mark[1]:.1f}s compile"
-
-    def compile_metrics(self) -> Dict[str, float]:
-        """Aggregate counters for the metric flush (see metric.flush_metrics)."""
-        count, seconds = self.totals()
-        if count == 0:
-            return {}
-        return {
-            "Compile/executables": float(count),
-            "Compile/compile_time_s": round(seconds, 3),
-        }
-
-    def reset(self) -> None:
-        with self._lock:
-            self._stats.clear()
-
-
-#: The process-global monitor every AOTFunction reports into.
-COMPILE_MONITOR = CompileMonitor()
-
-
-class CheckpointMonitor:
-    """Process-global accounting for the checkpointing subsystem
-    (``sheeprl_tpu.checkpoint``) — the same pattern as
-    :class:`CompileMonitor`: writer threads record, ``metric.flush_metrics``
-    surfaces the counters as ``Checkpoint/*`` without the loops threading a
-    handle through."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.reset()
-
-    def reset(self) -> None:
-        with self._lock:
-            self._saves = 0
-            self._async_saves = 0
-            self._errors = 0
-            self._bytes_total = 0
-            self._seconds_total = 0.0
-            self._last_seconds = 0.0
-            self._last_bytes = 0
-            self._max_depth = 0
-
-    def record_save(self, seconds: float, nbytes: int, asynchronous: bool) -> None:
-        with self._lock:
-            self._saves += 1
-            self._async_saves += 1 if asynchronous else 0
-            self._bytes_total += int(nbytes)
-            self._seconds_total += float(seconds)
-            self._last_seconds = float(seconds)
-            self._last_bytes = int(nbytes)
-
-    def record_error(self) -> None:
-        with self._lock:
-            self._errors += 1
-
-    def record_depth(self, depth: int) -> None:
-        with self._lock:
-            self._max_depth = max(self._max_depth, int(depth))
-
-    def metrics(self) -> Dict[str, float]:
-        """``Checkpoint/save_s`` is the LAST save's wall time — for async
-        saves that is writer-thread time overlapped with training, i.e. the
-        cost a synchronous save would have put on the critical path."""
-        with self._lock:
-            if self._saves == 0:
-                return {}
-            return {
-                "Checkpoint/save_s": round(self._last_seconds, 4),
-                "Checkpoint/bytes": float(self._last_bytes),
-                "Checkpoint/total_saves": float(self._saves),
-                "Checkpoint/total_bytes": float(self._bytes_total),
-                "Checkpoint/queue_depth_max": float(self._max_depth),
-            }
-
-    def totals(self) -> Dict[str, float]:
-        with self._lock:
-            return {
-                "saves": self._saves,
-                "async_saves": self._async_saves,
-                "errors": self._errors,
-                "bytes": self._bytes_total,
-                "seconds": round(self._seconds_total, 4),
-            }
-
-
-#: The process-global monitor the checkpoint writer reports into.
-CHECKPOINT_MONITOR = CheckpointMonitor()
-
-
-class ResilienceMonitor:
-    """Process-global accounting for the resilience subsystem
-    (``sheeprl_tpu.resilience``) — retries, watchdog stalls, env restarts,
-    circuit-breaker transitions, quarantined snapshots, injected faults.
-    Same pattern as the other monitors: primitives record from any thread,
-    ``metric.flush_metrics`` surfaces the counters as ``Resilience/*``.
-
-    When nothing has been recorded, :meth:`metrics` returns ``{}`` — a run
-    with fault injection disabled and no recoveries emits NO ``Resilience/*``
-    metrics at all (part of the zero-overhead-when-disabled gate)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.Lock()
-        self.reset()
-
-    def reset(self) -> None:
-        with self._lock:
-            self._retries = 0
-            self._retry_successes = 0
-            self._giveups = 0
-            self._stalls = 0
-            self._env_restarts = 0
-            self._breaker_opens = 0
-            self._quarantined = 0
-            self._injected = 0
-            self._injected_by_site: Dict[str, int] = {}
-
-    def record_retry(self, site: str = "") -> None:
-        with self._lock:
-            self._retries += 1
-
-    def record_retry_success(self, site: str = "") -> None:
-        with self._lock:
-            self._retry_successes += 1
-
-    def record_giveup(self, site: str = "") -> None:
-        with self._lock:
-            self._giveups += 1
-
-    def record_stall(self, name: str = "") -> None:
-        with self._lock:
-            self._stalls += 1
-
-    def record_env_restart(self, count: int = 1) -> None:
-        with self._lock:
-            self._env_restarts += int(count)
-
-    def record_breaker(self, name: str, state: str) -> None:
-        if state == "open":
-            with self._lock:
-                self._breaker_opens += 1
-
-    def record_quarantine(self, path: Any = None) -> None:
-        with self._lock:
-            self._quarantined += 1
-
-    def record_injection(self, site: str, kind: str) -> None:
-        with self._lock:
-            self._injected += 1
-            self._injected_by_site[site] = self._injected_by_site.get(site, 0) + 1
-
-    def metrics(self) -> Dict[str, float]:
-        with self._lock:
-            out: Dict[str, float] = {}
-            if self._retries:
-                out["Resilience/retries"] = float(self._retries)
-            if self._retry_successes:
-                out["Resilience/retry_successes"] = float(self._retry_successes)
-            if self._giveups:
-                out["Resilience/giveups"] = float(self._giveups)
-            if self._stalls:
-                out["Resilience/watchdog_stalls"] = float(self._stalls)
-            if self._env_restarts:
-                out["Resilience/env_restarts"] = float(self._env_restarts)
-            if self._breaker_opens:
-                out["Resilience/breaker_opens"] = float(self._breaker_opens)
-            if self._quarantined:
-                out["Resilience/quarantined_snapshots"] = float(self._quarantined)
-            if self._injected:
-                out["Resilience/faults_injected"] = float(self._injected)
-            return out
-
-    def totals(self) -> Dict[str, Any]:
-        with self._lock:
-            return {
-                "retries": self._retries,
-                "retry_successes": self._retry_successes,
-                "giveups": self._giveups,
-                "stalls": self._stalls,
-                "env_restarts": self._env_restarts,
-                "breaker_opens": self._breaker_opens,
-                "quarantined": self._quarantined,
-                "injected": self._injected,
-                "injected_by_site": dict(self._injected_by_site),
-            }
-
-
-#: The process-global monitor every resilience primitive reports into.
-RESILIENCE_MONITOR = ResilienceMonitor()
+from sheeprl_tpu.telemetry.monitors import (  # noqa: F401  (thin shims)
+    CHECKPOINT_MONITOR,
+    COMPILE_MONITOR,
+    RESILIENCE_MONITOR,
+    CheckpointMonitor,
+    CompileMonitor,
+    RecompileLimitExceeded,
+    ResilienceMonitor,
+)
 
 
 class ProfilerGate:

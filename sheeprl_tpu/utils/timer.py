@@ -22,6 +22,8 @@ import time
 from contextlib import ContextDecorator
 from typing import Any, ClassVar, Dict
 
+from sheeprl_tpu.telemetry.spans import SPANS, TIMER_PHASES
+
 
 class timer(ContextDecorator):
     disabled: ClassVar[bool] = False
@@ -59,10 +61,19 @@ class timer(ContextDecorator):
     def __enter__(self) -> "timer":
         if timer.sync and not timer.disabled:
             timer._drain_device()
+        # phase-span bridge (telemetry/spans.py): the two timers every train
+        # loop already wraps ARE the rollout / update.dispatch phases — one
+        # mapping here wires all 12 loops.  Independent of `disabled`: spans
+        # (and the tracer tick stream they drive) stay live at
+        # metric.log_level=0, which is how bench runs get phase breakdowns.
+        phase = TIMER_PHASES.get(self.name)
+        self._span = SPANS.push(phase) if phase is not None else None
         self._start = time.perf_counter()
         return self
 
     def __exit__(self, *exc: Any) -> bool:
+        if self._span is not None:
+            SPANS.pop(self._span)
         if not timer.disabled:
             if timer.sync:
                 timer._drain_device()

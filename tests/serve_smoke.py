@@ -98,6 +98,18 @@ def main() -> None:
         assert stats["served"] >= 24 and stats["errors"] == 0, stats
         assert np.isfinite(stats["p50_ms"]) and np.isfinite(stats["p99_ms"]), stats
 
+        # the telemetry-hub export on the serve surface (PR 13): the same
+        # stats in Prometheus text exposition format at /metrics
+        import urllib.request
+
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as resp:
+            assert resp.status == 200
+            ctype = resp.headers.get("Content-Type", "")
+            body = resp.read().decode()
+        assert ctype == "text/plain; version=0.0.4; charset=utf-8", ctype
+        assert "sheeprl_serve_served" in body, body[:400]
+        print("[serve_smoke] /metrics OK (Prometheus exposition via the telemetry hub)")
+
         proc.send_signal(signal.SIGINT)
         rc = proc.wait(60)
         assert rc == 0, f"server exited rc={rc} on SIGINT (expected clean shutdown)"

@@ -1,0 +1,25 @@
+import pytest
+
+from sheeprl_tpu.telemetry import HUB, RECORDER, SPANS, TRACER
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Every telemetry test starts from default knobs and empty windows.
+
+    The monitors themselves are process-global cumulative counters shared
+    with the rest of the suite — tests here assert DELTAS or register their
+    own sources rather than resetting them."""
+    SPANS.reset()
+    RECORDER.clear()
+    RECORDER.enabled = True
+    RECORDER._run_dir = None
+    TRACER.configure({}, None)
+    HUB.reset()
+    yield
+    SPANS.reset()
+    RECORDER.clear()
+    RECORDER._run_dir = None
+    TRACER.configure({}, None)
+    HUB.reset()
+    HUB.unregister("test_source")

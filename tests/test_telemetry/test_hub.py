@@ -1,0 +1,113 @@
+"""TelemetryHub contract: monitor-shim equivalence, registration, flush."""
+
+from sheeprl_tpu.telemetry import HUB
+from sheeprl_tpu.telemetry.spans import SPANS
+
+
+class TestMonitorShims:
+    def test_profiler_globals_are_the_telemetry_monitors(self):
+        """The old ``utils.profiler`` globals are thin shims: the SAME
+        objects the telemetry subsystem owns, not copies."""
+        from sheeprl_tpu.telemetry import monitors
+        from sheeprl_tpu.utils import profiler
+
+        assert profiler.COMPILE_MONITOR is monitors.COMPILE_MONITOR
+        assert profiler.CHECKPOINT_MONITOR is monitors.CHECKPOINT_MONITOR
+        assert profiler.RESILIENCE_MONITOR is monitors.RESILIENCE_MONITOR
+        assert profiler.RecompileLimitExceeded is monitors.RecompileLimitExceeded
+
+    def test_recording_via_old_global_reaches_hub_flush(self):
+        """A record through the legacy import path surfaces as the same
+        ``Compile/*`` / ``Resilience/*`` metrics through ``HUB.flush()``."""
+        from sheeprl_tpu.utils.profiler import COMPILE_MONITOR, RESILIENCE_MONITOR
+
+        exe_before = HUB.flush().get("Compile/executables", 0.0)
+        COMPILE_MONITOR.begin("hub_shim_test", "sig0")
+        COMPILE_MONITOR.end("hub_shim_test", 0.25)
+        retries_before = RESILIENCE_MONITOR.totals()["retries"]
+        RESILIENCE_MONITOR.record_retry("hub_shim_test")
+        out = HUB.flush()
+        assert out["Compile/executables"] == exe_before + 1
+        assert out["Resilience/retries"] == float(retries_before + 1)
+
+    def test_checkpoint_monitor_flows_through_hub(self):
+        from sheeprl_tpu.utils.profiler import CHECKPOINT_MONITOR
+
+        saves_before = CHECKPOINT_MONITOR.totals()["saves"]
+        CHECKPOINT_MONITOR.record_save(seconds=0.5, nbytes=1024, asynchronous=True)
+        out = HUB.flush()
+        assert out["Checkpoint/total_saves"] == float(saves_before + 1)
+        assert out["Checkpoint/save_s"] == 0.5
+
+
+class TestRegistration:
+    def test_register_callable_and_object_sources(self):
+        class Source:
+            def metrics(self):
+                return {"Obj/x": 2.0}
+
+        HUB.register("test_source", lambda: {"Call/x": 1.0})
+        assert HUB.flush()["Call/x"] == 1.0
+        HUB.register("test_source", Source())  # re-register replaces
+        out = HUB.flush()
+        assert out["Obj/x"] == 2.0
+        assert "Call/x" not in out
+        HUB.unregister("test_source")
+        assert "Obj/x" not in HUB.flush()
+
+    def test_broken_source_is_skipped_not_fatal(self):
+        def broken():
+            raise RuntimeError("bad exporter")
+
+        HUB.register("test_source", broken)
+        out = HUB.flush()  # must not raise
+        assert isinstance(out, dict)
+        HUB.unregister("test_source")
+
+    def test_source_names_listed(self):
+        HUB.register("test_source", lambda: {})
+        assert "test_source" in HUB.source_names()
+        # the monitors registered at import are permanent residents
+        for name in ("compile", "checkpoint", "resilience", "spans"):
+            assert name in HUB.source_names()
+
+
+class TestFlushContract:
+    def test_flush_roll_resets_span_window(self):
+        with SPANS.span("rollout"):
+            pass
+        assert "Phase/rollout" in HUB.flush(roll=False)
+        assert "Phase/rollout" in HUB.flush(roll=True)  # roll AFTER collect
+        assert "Phase/rollout" not in HUB.flush(roll=False)  # window rolled
+
+    def test_final_flush_lands_last_window_through_attached_logger(self):
+        logged = []
+
+        class FakeLogger:
+            def log_metrics(self, metrics, step):
+                logged.append((dict(metrics), step))
+
+        HUB.attach_logger(FakeLogger())
+        HUB.note_step(1234)
+        with SPANS.span("update.dispatch"):
+            pass
+        out = HUB.final_flush()
+        assert logged, "final_flush must log through the attached logger"
+        metrics, step = logged[0]
+        assert step == 1234
+        assert "Phase/update.dispatch" in metrics
+        assert metrics == out
+        # detached after: a second final flush must not double-log
+        logged.clear()
+        HUB.final_flush()
+        assert not logged
+
+    def test_final_flush_survives_broken_logger(self):
+        class ClosedLogger:
+            def log_metrics(self, metrics, step):
+                raise RuntimeError("writer closed")
+
+        HUB.attach_logger(ClosedLogger())
+        with SPANS.span("rollout"):
+            pass
+        HUB.final_flush()  # must not raise

@@ -1,0 +1,108 @@
+"""Introspection endpoint: Prometheus text-format golden, content type,
+and the JSON surfaces (/healthz, /v1/phase, /v1/recorder)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.telemetry import HUB
+from sheeprl_tpu.telemetry.introspect import (
+    PROMETHEUS_CONTENT_TYPE,
+    IntrospectionServer,
+    prometheus_name,
+    prometheus_text,
+)
+from sheeprl_tpu.telemetry.recorder import RECORDER
+from sheeprl_tpu.telemetry.spans import SPANS
+
+
+class TestPrometheusText:
+    def test_name_sanitization(self):
+        assert prometheus_name("Compile/executables") == "sheeprl_compile_executables"
+        assert prometheus_name("Phase/update.dispatch") == "sheeprl_phase_update_dispatch"
+        assert prometheus_name("Sebulba/queue_depth") == "sheeprl_sebulba_queue_depth"
+
+    def test_text_format_golden(self):
+        """The exposition format is a scrape contract: one TYPE line per
+        gauge, `name value` sample lines, sorted by key, trailing newline."""
+        text = prometheus_text(
+            {"Compile/executables": 3.0, "Phase/rollout": 0.25}
+        )
+        assert text == (
+            "# TYPE sheeprl_compile_executables gauge\n"
+            "sheeprl_compile_executables 3.0\n"
+            "# TYPE sheeprl_phase_rollout gauge\n"
+            "sheeprl_phase_rollout 0.25\n"
+        )
+
+    def test_empty_metrics_empty_body(self):
+        assert prometheus_text({}) == ""
+
+    def test_non_numeric_values_dropped(self):
+        assert "nan" not in prometheus_text({"A/b": "not-a-number"})
+
+
+@pytest.fixture()
+def server():
+    HUB.register("test_source", lambda: {"Test/metric": 1.5})
+    srv = IntrospectionServer(port=0).start()
+    yield srv
+    srv.stop()
+    HUB.unregister("test_source")
+
+
+def get(url: str):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, resp.headers.get("Content-Type"), resp.read().decode()
+
+
+class TestEndpoints:
+    def test_healthz(self, server):
+        status, ctype, body = get(server.url + "/healthz")
+        assert status == 200
+        assert ctype == "application/json"
+        doc = json.loads(body)
+        assert doc["ok"] is True
+        assert "test_source" in doc["sources"]
+        assert doc["pid"] > 0
+
+    def test_metrics_content_type_and_body(self, server):
+        status, ctype, body = get(server.url + "/metrics")
+        assert status == 200
+        assert ctype == PROMETHEUS_CONTENT_TYPE  # the golden scrape contract
+        assert "# TYPE sheeprl_test_metric gauge" in body
+        assert "sheeprl_test_metric 1.5" in body
+        assert "sheeprl_telemetry_uptime_s" in body
+
+    def test_metrics_scrape_is_non_destructive(self, server):
+        with SPANS.span("rollout"):
+            pass
+        _, _, first = get(server.url + "/metrics")
+        assert "sheeprl_phase_rollout" in first
+        _, _, second = get(server.url + "/metrics")
+        assert "sheeprl_phase_rollout" in second  # scrapes never roll windows
+
+    def test_phase_breakdown(self, server):
+        with SPANS.span("update.dispatch"):
+            pass
+        status, ctype, body = get(server.url + "/v1/phase")
+        assert status == 200
+        doc = json.loads(body)
+        assert "update.dispatch" in doc["phases"]
+        total = sum(p["frac"] for p in doc["phases"].values()) + doc["other_frac"]
+        assert total == pytest.approx(1.0, abs=1e-4)
+
+    def test_recorder_tail(self, server):
+        for i in range(5):
+            RECORDER.record("tick", i=i)
+        status, _, body = get(server.url + "/v1/recorder?n=2")
+        assert status == 200
+        doc = json.loads(body)
+        assert [e["i"] for e in doc["events"]] == [3, 4]
+        assert doc["total"] >= 5
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as err:
+            get(server.url + "/v1/nope")
+        assert err.value.code == 404

@@ -1,0 +1,150 @@
+"""Flight-recorder ring bounds + postmortem dumps, including the seeded
+``env.step`` raise chaos drill (every chaos path leaves evidence) and the
+final-metric-flush regression (buffered monitor counters must land even
+when the loop dies mid-window)."""
+
+import csv
+import glob
+import json
+import os
+
+import pytest
+
+from sheeprl_tpu.resilience import faults
+from sheeprl_tpu.resilience.faults import InjectedFault
+from sheeprl_tpu.telemetry.recorder import RECORDER, SCHEMA, FlightRecorder
+
+
+class TestRingBounds:
+    def test_ring_keeps_newest_capacity_events(self):
+        rec = FlightRecorder(capacity=8)
+        for i in range(20):
+            rec.record("tick", i=i)
+        assert len(rec) == 8
+        kept = [e["i"] for e in rec.snapshot()]
+        assert kept == list(range(12, 20))
+
+    def test_snapshot_tail(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(10):
+            rec.record("tick", i=i)
+        assert [e["i"] for e in rec.snapshot(3)] == [7, 8, 9]
+
+    def test_configure_resizes_preserving_newest(self):
+        rec = FlightRecorder(capacity=16)
+        for i in range(10):
+            rec.record("tick", i=i)
+        rec.configure({"capacity": 4})
+        assert len(rec) == 4
+        assert [e["i"] for e in rec.snapshot()] == [6, 7, 8, 9]
+
+    def test_disabled_records_nothing(self):
+        rec = FlightRecorder(capacity=4)
+        rec.configure({"enabled": False})
+        rec.record("tick")
+        assert len(rec) == 0
+
+
+class TestDump:
+    def test_dump_writes_parseable_schema(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        rec.configure({}, run_dir=str(tmp_path))
+        rec.record("fault.injected", site="env.step", fault="raise")
+        path = rec.dump("test-reason")
+        assert path == str(tmp_path / "postmortem.json")
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["schema"] == SCHEMA
+        assert doc["reason"] == "test-reason"
+        assert doc["pid"] == os.getpid()
+        assert doc["monitors"] is not None and "resilience" in doc["monitors"]
+        assert doc["phase_breakdown"] is not None
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "fault.injected" in kinds
+
+    def test_dump_without_run_dir_writes_nothing(self, tmp_path, monkeypatch):
+        monkeypatch.chdir(tmp_path)
+        rec = FlightRecorder(capacity=8)
+        rec.record("tick")
+        assert rec.dump("no-home") is None
+        assert not list(tmp_path.iterdir())
+
+    def test_explicit_path_wins(self, tmp_path):
+        rec = FlightRecorder(capacity=8)
+        target = tmp_path / "sub" / "pm.json"
+        assert rec.dump("explicit", path=str(target)) == str(target)
+        assert json.load(open(target))["reason"] == "explicit"
+
+
+# env.step raises on its 10th invocation — mid-run, after compiles happened
+# but (with metric.log_every sky-high) before ANY periodic metric flush
+DRILL_PLAN = json.dumps(
+    {"seed": 7, "plan": [{"site": "env.step", "kind": "raise", "at": 10}]}
+)
+
+
+def test_chaos_drill_leaves_postmortem_and_final_flush(tmp_path, monkeypatch):
+    """A seeded ``env.step`` raise kills a real ``cli.run`` mid-training:
+    the run dir must hold a parseable ``postmortem.json`` whose ring
+    contains the injected-fault event AND a metrics file carrying the final
+    ``Compile/*`` / ``Resilience/*`` flush (the finally-path hub flush —
+    without it everything buffered since the last interval is lost)."""
+    from sheeprl_tpu.cli import run
+
+    monkeypatch.setenv(faults.ENV_VAR, DRILL_PLAN)
+    try:
+        with pytest.raises(InjectedFault):
+            run(
+                [
+                    "exp=ppo",
+                    "env=dummy",
+                    "env.id=discrete_dummy",
+                    "env.num_envs=2",
+                    "env.sync_env=True",
+                    "env.capture_video=False",
+                    "algo.rollout_steps=8",
+                    "algo.per_rank_batch_size=16",
+                    "algo.update_epochs=1",
+                    "algo.total_steps=128",
+                    "algo.mlp_keys.encoder=[state]",
+                    "algo.cnn_keys.encoder=[]",
+                    "algo.run_test=False",
+                    "fabric.devices=1",
+                    "fabric.accelerator=cpu",
+                    "checkpoint.every=0",
+                    "checkpoint.save_last=False",
+                    "buffer.memmap=False",
+                    "metric.log_level=1",
+                    "metric.log_every=1000000",  # NO periodic flush fires
+                    "metric.logger.kind=csv",
+                    f"log_dir={tmp_path}/logs",
+                    "print_config=False",
+                ]
+            )
+    finally:
+        faults.clear_plan()
+
+    run_dirs = glob.glob(f"{tmp_path}/logs/**/version_*", recursive=True)
+    assert run_dirs, "the run never created its version dir"
+
+    # 1) the postmortem: parseable, right reason, injected fault in the ring
+    pm_path = os.path.join(run_dirs[0], "postmortem.json")
+    assert os.path.isfile(pm_path), "crash exit left no postmortem.json"
+    doc = json.load(open(pm_path))
+    assert doc["schema"] == SCHEMA
+    assert doc["reason"] == "exception"
+    events = doc["events"]
+    faults_seen = [e for e in events if e["kind"] == "fault.injected"]
+    assert faults_seen and faults_seen[0]["site"] == "env.step"
+    crashes = [e for e in events if e["kind"] == "crash"]
+    assert crashes and "InjectedFault" in crashes[0]["error"]
+    assert doc["monitors"]["resilience"]["injected"] >= 1
+
+    # 2) the final flush: the ONLY metrics csv rows are the finally-path
+    # hub flush (log_every was unreachable), and they carry the buffered
+    # Compile/* and Resilience/* counters
+    csv_path = os.path.join(run_dirs[0], "metrics.csv")
+    assert os.path.isfile(csv_path)
+    names = {row["name"] for row in csv.DictReader(open(csv_path))}
+    assert "Compile/executables" in names
+    assert "Resilience/faults_injected" in names

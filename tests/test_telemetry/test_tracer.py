@@ -1,0 +1,107 @@
+"""Trace-window arming: update numbers, SHEEPRL_TRACE_AT, SIGUSR1."""
+
+import os
+import signal
+
+import pytest
+
+from sheeprl_tpu.telemetry.tracer import ENV_VAR, TraceScheduler
+
+
+def make_scheduler(tmp_path, **tcfg):
+    starts, stops = [], []
+    sched = TraceScheduler(
+        start_fn=lambda path: starts.append(path),
+        stop_fn=lambda: stops.append(True),
+    )
+    sched.configure(tcfg, str(tmp_path))
+    return sched, starts, stops
+
+
+class TestUpdateNumberArming:
+    def test_window_opens_and_closes_at_configured_updates(self, tmp_path):
+        sched, starts, stops = make_scheduler(tmp_path, trace_at=[3], trace_updates=2)
+        for _ in range(2):
+            sched.tick()
+        assert not starts and not sched.active
+        sched.tick()  # update 3: window opens
+        assert sched.active
+        assert len(starts) == 1
+        assert starts[0].endswith(os.path.join("trace", "update_000003"))
+        sched.tick()  # update 4: still inside the 2-update window
+        assert sched.active and not stops
+        sched.tick()  # update 5: window closed before this dispatch
+        assert not sched.active
+        assert len(stops) == 1
+        assert sched.windows_captured == 1
+
+    def test_multiple_windows(self, tmp_path):
+        sched, starts, stops = make_scheduler(
+            tmp_path, trace_at=[2, 5], trace_updates=1
+        )
+        for _ in range(7):
+            sched.tick()
+        assert len(starts) == 2
+        assert len(stops) == 2
+        assert not sched.active
+
+    def test_env_var_merges_with_config(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_VAR, "2, 4")
+        sched, starts, _ = make_scheduler(tmp_path, trace_at=[6], trace_updates=1)
+        for _ in range(7):
+            sched.tick()
+        assert len(starts) == 3  # 2 and 4 from the env, 6 from the config
+
+    def test_malformed_env_var_warns_not_crashes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_VAR, "not-a-number")
+        with pytest.warns(RuntimeWarning):
+            sched, starts, _ = make_scheduler(tmp_path, trace_at=[1], trace_updates=1)
+        sched.tick()
+        assert len(starts) == 1  # config arming still works
+
+    def test_broken_profiler_never_kills_training(self, tmp_path):
+        sched = TraceScheduler(
+            start_fn=lambda path: (_ for _ in ()).throw(RuntimeError("no profiler")),
+            stop_fn=lambda: None,
+        )
+        sched.configure({"trace_at": [1], "trace_updates": 1}, str(tmp_path))
+        sched.tick()  # must not raise
+        assert not sched.active
+
+    def test_configure_resets_counter_and_closes_open_window(self, tmp_path):
+        sched, starts, stops = make_scheduler(tmp_path, trace_at=[1], trace_updates=10)
+        sched.tick()
+        assert sched.active
+        sched.configure({"trace_at": [1], "trace_updates": 1}, str(tmp_path))
+        assert not sched.active and len(stops) == 1
+        assert sched.update_count == 0
+        sched.tick()  # re-arms: update numbers are per run
+        assert len(starts) == 2
+
+
+class TestSignalArming:
+    def test_sigusr1_arms_one_window_at_next_tick(self, tmp_path):
+        sched, starts, stops = make_scheduler(tmp_path, trace_updates=2)
+        previous = signal.getsignal(signal.SIGUSR1)
+        try:
+            assert sched.install_signal()
+            sched.tick()
+            assert not starts  # nothing armed yet
+            os.kill(os.getpid(), signal.SIGUSR1)
+            sched.tick()  # the signal arms exactly one window
+            assert sched.active
+            assert len(starts) == 1
+            sched.tick()
+            sched.tick()
+            assert not sched.active
+            assert len(stops) == 1
+            sched.tick()  # one-shot: no re-arm without a new signal
+            assert len(starts) == 1
+        finally:
+            signal.signal(signal.SIGUSR1, previous)
+
+    def test_request_is_the_programmatic_signal_spelling(self, tmp_path):
+        sched, starts, _ = make_scheduler(tmp_path, trace_updates=1)
+        sched.request()
+        sched.tick()
+        assert len(starts) == 1
