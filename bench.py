@@ -1189,6 +1189,129 @@ def bench_telemetry_overhead() -> dict:
     }
 
 
+def bench_health_overhead() -> dict:
+    """Cost gate for the default-on training-health sentinels
+    (resilience/health.py, docs/supervisor.md).
+
+    The non-finite guard compiles INTO the update dispatch: after the
+    train phase's own math it reduces ``isfinite`` over the loss and the
+    fresh params and selects old-vs-new — extra device work every window,
+    so unlike the fault/telemetry gates the two arms here are genuinely
+    DIFFERENT executables: A is the health-guarded DreamerV3 train phase
+    (``health.enabled=true``, the default), B is the same phase with the
+    sentinel compiled out.  Both are AOT-warmed, then timed as interleaved
+    A/B windows with the min-of-N estimator (host noise is one-sided);
+    the guarded arm must stay within ``BENCH_HEALTH_TOL`` (default 2%).
+
+    ``gate_failed: true`` in the payload (and a nonzero exit) on violation.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.resilience.health import HealthSentinel
+    from sheeprl_tpu.utils.utils import device_sync
+
+    size = os.environ.get("BENCH_SIZE", "XS")
+    L = int(os.environ.get("BENCH_L", 8))
+    B = int(os.environ.get("BENCH_B", 4))
+    U = int(os.environ.get("BENCH_U", 2))
+    samples = int(os.environ.get("BENCH_HEALTH_SAMPLES", 12))
+    tol = float(os.environ.get("BENCH_HEALTH_TOL", 0.02))
+
+    cfg = compose(
+        [
+            "exp=dreamer_v3",
+            "env=dummy",
+            "env.id=discrete_dummy",
+            f"algo=dreamer_v3_{size}",
+            "algo.cnn_keys.encoder=[rgb]",
+            "algo.mlp_keys.encoder=[]",
+            f"algo.per_rank_batch_size={B}",
+            f"algo.per_rank_sequence_length={L}",
+        ]
+    )
+    fabric = build_fabric(cfg)
+    rng = np.random.default_rng(0)
+    block = {
+        "rgb": jnp.asarray(rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(np.uint8)),
+        "actions": jnp.asarray(rng.integers(0, 2, (U, L, B, 4)).astype(np.float32)),
+        "rewards": jnp.asarray(rng.normal(size=(U, L, B)).astype(np.float32)),
+        "terminated": jnp.zeros((U, L, B), jnp.float32),
+        "is_first": jnp.zeros((U, L, B), jnp.float32),
+    }
+    train_phase, params, opt_state = _build_dv3_train_phase(fabric, cfg)
+    block = fabric.shard_batch(block, axis=2)
+    key = jax.random.PRNGKey(0)
+
+    sentinel = HealthSentinel(cfg.get("health") or {}, fabric)
+    guarded = fabric.compile(
+        sentinel.wrap(train_phase),
+        name="bench.health_guarded",
+        donate_argnums=(0, 1, 2),
+    )
+
+    # per-arm state chains (the arms are different executables and both
+    # donate their params/opt-state — each must consume only its own)
+    p_a = jax.tree.map(jnp.copy, params)
+    o_a = jax.tree.map(jnp.copy, opt_state)
+    p_b, o_b = params, opt_state
+    h = sentinel.init_state()
+
+    # warm both executables before timing anything
+    h, p_a, o_a, m = guarded(h, p_a, o_a, block, key, jnp.int32(0))
+    device_sync((p_a, m))
+    p_b, o_b, m = train_phase(p_b, o_b, block, key, jnp.int32(0))
+    device_sync((p_b, m))
+
+    step = 0
+
+    def one_dispatch(guarded_arm: bool):
+        nonlocal p_a, o_a, p_b, o_b, h, step
+        t0 = time.perf_counter()
+        if guarded_arm:
+            h, p_a, o_a, m = guarded(h, p_a, o_a, block, key, jnp.int32(step))
+            device_sync((p_a, m))
+        else:
+            p_b, o_b, m = train_phase(p_b, o_b, block, key, jnp.int32(step))
+            device_sync((p_b, m))
+        step += 1
+        return time.perf_counter() - t0
+
+    one_dispatch(False)  # discard one warm-in dispatch (caches, allocator)
+    one_dispatch(True)
+
+    # interleaved A/B, min-of-N estimator (the fault_overhead pattern)
+    baseline, instrumented = [], []
+    for s in range(2 * samples):
+        if s % 2 == 0:
+            baseline.append(one_dispatch(False))
+        else:
+            instrumented.append(one_dispatch(True))
+
+    base = U / min(baseline)
+    instr = U / min(instrumented)
+    # directional: only a SLOWDOWN of the guarded arm is a regression
+    overhead = max(0.0, (base - instr) / base)
+    gate_failed = overhead >= tol or guarded.cache_size() != 1
+    return {
+        "metric": (
+            f"health_sentinel_overhead "
+            f"(dreamer_v3_{size} B={B} L={L} U={U}, {samples}x interleaved A/B, min-estimator)"
+        ),
+        "value": round(overhead * 100, 3),
+        "unit": "%",
+        "vs_baseline": None,
+        "steady_updates_per_s_unguarded": round(base, 4),
+        "steady_updates_per_s_guarded": round(instr, 4),
+        "tolerance_pct": tol * 100,
+        "guarded_cache_size": guarded.cache_size(),
+        "gate_failed": gate_failed,
+    }
+
+
 def _run_bench() -> dict:
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
     if target == "serve":
@@ -1199,6 +1322,8 @@ def _run_bench() -> dict:
         return bench_fault_overhead()
     if target == "telemetry_overhead":
         return bench_telemetry_overhead()
+    if target == "health_overhead":
+        return bench_health_overhead()
     if target == "env":
         return bench_env()
     if target == "sebulba":

@@ -239,6 +239,22 @@ def dreamer_family_loop(
         cnn_keys=cnn_keys, mlp_keys=mlp_keys, is_continuous=is_continuous,
         params=params, opt_state=opt_state,
     )
+    # training-health sentinels (resilience/health.py): wrap the compiled
+    # phase (it inlines under the guard's trace) with the non-finite guard +
+    # divergence detector, threading the tiny device HealthState first.
+    # Covers every dreamer-family entry point — the p2e builders need no
+    # changes.  health.enabled=false keeps the exact unguarded program.
+    from sheeprl_tpu.resilience.health import DivergenceError, HealthSentinel
+
+    sentinel = HealthSentinel.from_config(cfg, fabric)
+    if sentinel is not None:
+        sentinel.register()
+        train_phase = fabric.compile(
+            sentinel.wrap(train_phase),
+            name=f"{cfg.algo.name}.train_phase_guarded",
+            donate_argnums=(0, 1, 2),
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
 
     # ---------------- replay buffer ------------------------------------------
     seq_len = int(cfg.algo.per_rank_sequence_length)
@@ -318,6 +334,7 @@ def dreamer_family_loop(
             _prep_blocks,
             name=f"{cfg.algo.name}.train_phase_device",
             max_recompiles=cfg.algo.get("max_recompiles"),
+            health=sentinel is not None,
         )
     guard_on = bool(cfg.buffer.get("transfer_guard", False)) and use_device_replay
     # a checkpoint only contains "rb" if it was saved with buffer.checkpoint
@@ -363,6 +380,7 @@ def dreamer_family_loop(
     step_data["is_first"] = np.ones((1, num_envs), np.float32)
     last_metrics = None
     counter_dev = None  # device-resident grad-step counter (zero-copy path)
+    h_dev = None  # device-resident sentinel state (resilience/health.py)
     train_windows = 0  # completed dispatched windows (guards arm past warmup)
     # per-rank player key stream, advanced inside player_step; the main
     # `key` stays rank-identical for train dispatches
@@ -519,6 +537,8 @@ def dreamer_family_loop(
                         # placement — a single-device stage would cost one
                         # extra (first-window) executable on multi-device
                         counter_dev = fabric.replicate(np.int32(grad_step_counter))
+                    if sentinel is not None and h_dev is None:
+                        h_dev = sentinel.init_state()
                     player_params = psync.before_dispatch(player_params)
                     with steady_guard(guard_on and train_windows > 0):
                         # chunk cap honors BOTH budgets: compile reuse and the
@@ -528,10 +548,18 @@ def dreamer_family_loop(
                             bytes_per_update=rb.sampled_bytes_per_update(batch_size, seq_len),
                         ):
                             key, tk = jax.random.split(key)
-                            params, opt_state, counter_dev, last_metrics = train_phase_dev(
-                                params, opt_state, rb.buffers, rb.cursor, tk,
-                                counter_dev, n_samples=u,
-                            )
+                            if sentinel is not None:
+                                params, opt_state, h_dev, counter_dev, last_metrics = (
+                                    train_phase_dev(
+                                        params, opt_state, h_dev, rb.buffers, rb.cursor,
+                                        tk, counter_dev, n_samples=u,
+                                    )
+                                )
+                            else:
+                                params, opt_state, counter_dev, last_metrics = train_phase_dev(
+                                    params, opt_state, rb.buffers, rb.cursor, tk,
+                                    counter_dev, n_samples=u,
+                                )
                             grad_step_counter += u
                     train_windows += 1
                     player_params = psync.after_dispatch(params, player_params)
@@ -570,11 +598,38 @@ def dreamer_family_loop(
                         blocks["is_first"] = jnp.asarray(np.asarray(sample["is_first"], np.float32)[..., 0])
                         blocks = fabric.shard_batch(blocks, axis=2)
                         key, tk = jax.random.split(key)
-                        params, opt_state, last_metrics = train_phase(
-                            params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
-                        )
+                        if sentinel is not None:
+                            if h_dev is None:
+                                h_dev = sentinel.init_state()
+                            h_dev, params, opt_state, last_metrics = train_phase(
+                                h_dev, params, opt_state, blocks, tk,
+                                jnp.int32(grad_step_counter),
+                            )
+                        else:
+                            params, opt_state, last_metrics = train_phase(
+                                params, opt_state, blocks, tk, jnp.int32(grad_step_counter)
+                            )
                         grad_step_counter += u
                     player_params = psync.after_dispatch(params, player_params)
+
+        # ---------------- training-health sentinel -----------------------------
+        # per-interval host poll of the device HealthState: Health/* metrics
+        # through the hub + recorder events.  The dreamer loops implement
+        # rollback through the process boundary: the typed DivergenceError
+        # reaches cli.run's crash path (postmortem reason surfaced) and the
+        # supervisor relaunches with checkpoint.resume_from=auto — i.e.
+        # rollback to the last committed snapshot.
+        if (
+            sentinel is not None
+            and h_dev is not None
+            and sentinel.should_poll(update, total_iters)
+            and sentinel.poll(h_dev, policy_step) == "rollback"
+        ):
+            raise DivergenceError(
+                f"training diverged at step {policy_step}; relaunch with "
+                "checkpoint.resume_from=auto to roll back to the last committed "
+                "snapshot (sheeprl-tpu-supervise does this automatically)"
+            )
 
         # ---------------- logging ---------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -629,6 +684,8 @@ def dreamer_family_loop(
 
     profiler.close()
     envs.close()
+    if sentinel is not None:
+        sentinel.close()
     if getattr(rb, "spill", None) is not None:
         rb.spill.close()
     ckpt_mgr.finalize()

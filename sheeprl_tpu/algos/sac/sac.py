@@ -43,8 +43,10 @@ from sheeprl_tpu.data.device_replay import (
     steady_guard,
     update_chunks,
 )
+from sheeprl_tpu.checkpoint.rollback import rollback_state
 from sheeprl_tpu.parallel.compile import compile_once
 from sheeprl_tpu.parallel.fabric import PlayerSync
+from sheeprl_tpu.resilience.health import DivergenceError, HealthSentinel
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
 from sheeprl_tpu.utils.logger import get_log_dir, get_logger
 from sheeprl_tpu.utils.metric import MetricAggregator, flush_metrics
@@ -231,6 +233,20 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     act_fn, train_phase = make_sac_train_fns(
         actor, critic, critic_apply, actor_opt, critic_opt, alpha_opt, cfg, act_dim
     )
+    # training-health sentinels (resilience/health.py): the guarded program
+    # wraps the compiled phase (it inlines under the trace, like the fused
+    # replay programs) and threads the tiny device HealthState first —
+    # health.enabled=false compiles the guard OUT and every call site below
+    # keeps the exact unguarded program
+    sentinel = HealthSentinel.from_config(cfg, fabric)
+    if sentinel is not None:
+        sentinel.register()
+        train_phase = compile_once(
+            sentinel.wrap(train_phase),
+            name=f"{cfg.algo.name}.train_phase_guarded",
+            donate_argnums=(0, 1, 2),
+            max_recompiles=cfg.algo.get("max_recompiles"),
+        )
     player_params = psync.init(params)
 
     # ---------------- counters ----------------------------------------------
@@ -307,6 +323,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
             _prep_batch,
             name=f"{cfg.algo.name}.train_phase_device",
             max_recompiles=cfg.algo.get("max_recompiles"),
+            health=sentinel is not None,
         )
     guard_on = bool(cfg.buffer.get("transfer_guard", False)) and use_device_replay
 
@@ -317,6 +334,7 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
     obs_vec = np.asarray(prepare_obs(obs, mlp_keys))
     last_losses = None
     counter_dev = None  # device-resident grad-step counter (zero-copy path)
+    h_dev = None  # device-resident sentinel state (resilience/health.py)
     train_windows = 0  # completed dispatched windows (guards arm past warmup)
     # per-rank player key stream, advanced inside act_fn; the main `key`
     # stays rank-identical for train dispatches
@@ -399,16 +417,26 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                         # placement — a single-device stage would cost one
                         # extra (first-window) executable on multi-device
                         counter_dev = fabric.replicate(np.int32(grad_step_counter))
+                    if sentinel is not None and h_dev is None:
+                        h_dev = sentinel.init_state()
                     player_params = psync.before_dispatch(player_params)
                     with steady_guard(guard_on and train_windows > 0):
                         for u in update_chunks(
                             due, bytes_per_update=rb.sampled_bytes_per_update(batch_size)
                         ):
                             key, tk = jax.random.split(key)
-                            params, opt_state, counter_dev, last_losses = train_phase_dev(
-                                params, opt_state, rb.buffers, rb.cursor, tk,
-                                counter_dev, n_samples=u,
-                            )
+                            if sentinel is not None:
+                                params, opt_state, h_dev, counter_dev, last_losses = (
+                                    train_phase_dev(
+                                        params, opt_state, h_dev, rb.buffers, rb.cursor,
+                                        tk, counter_dev, n_samples=u,
+                                    )
+                                )
+                            else:
+                                params, opt_state, counter_dev, last_losses = train_phase_dev(
+                                    params, opt_state, rb.buffers, rb.cursor, tk,
+                                    counter_dev, n_samples=u,
+                                )
                             grad_step_counter += u
                     train_windows += 1
                     player_params = psync.after_dispatch(params, player_params)
@@ -430,11 +458,58 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
                     # compute (before_dispatch blocks on it — see PlayerSync)
                     player_params = psync.before_dispatch(player_params)
                     key, tk = jax.random.split(key)
-                    params, opt_state, last_losses = train_phase(
-                        params, opt_state, batches, tk, jnp.int32(grad_step_counter)
-                    )
+                    if sentinel is not None:
+                        if h_dev is None:
+                            h_dev = sentinel.init_state()
+                        h_dev, params, opt_state, last_losses = train_phase(
+                            h_dev, params, opt_state, batches, tk,
+                            jnp.int32(grad_step_counter),
+                        )
+                    else:
+                        params, opt_state, last_losses = train_phase(
+                            params, opt_state, batches, tk, jnp.int32(grad_step_counter)
+                        )
                     grad_step_counter += due
                     player_params = psync.after_dispatch(params, player_params)
+
+        # ---------------- training-health sentinel ---------------------------
+        # the one D2H of the sentinel: a per-interval fetch of the tiny
+        # HealthState, publishing Health/* through the hub and deciding
+        # whether the divergence detector demands a rollback
+        if (
+            sentinel is not None
+            and h_dev is not None
+            and sentinel.should_poll(update, total_iters)
+            and sentinel.poll(h_dev, policy_step) == "rollback"
+        ):
+            sentinel.begin_rollback(policy_step)  # raises past the budget
+            rb_state, rb_dir = rollback_state(ckpt_mgr, fabric)
+            if rb_state is None:
+                raise DivergenceError(
+                    f"training diverged at step {policy_step} with no committed "
+                    "checkpoint to roll back to"
+                )
+            # restore exactly like a resume: params through the agent builder
+            # (identical placement, so the guarded executable is reusable),
+            # opt state/RNG streams replicated, grad-step counter rewound.
+            # The replay buffer is NOT rolled back — transitions collected by
+            # the diverged policy are still valid off-policy data.
+            _, _, params = build_agent_fn(fabric, act_dim, cfg, obs_dim, rb_state["agent"])
+            opt_state = fabric.replicate(rb_state["opt_state"])
+            if rb_state.get("key") is not None:
+                key = jnp.asarray(rb_state["key"])
+            if rb_state.get("player_key") is not None:
+                player_key = jax.device_put(jnp.asarray(rb_state["player_key"]), host)
+            grad_step_counter = int(rb_state.get("grad_steps", grad_step_counter))
+            counter_dev = None  # re-staged (replicated) before the next window
+            h_dev = sentinel.reseed_state()  # diverged flag clears, dispatch count survives
+            player_params = psync.init(params)
+            last_losses = None
+            fabric.print(
+                f"health: diverged at step {policy_step} — rolled back to "
+                f"committed snapshot {rb_dir}"
+            )
+            sentinel.rolled_back(policy_step, rb_dir)
 
         # ---------------- logging -------------------------------------------
         if cfg.metric.log_level > 0 and (
@@ -483,6 +558,8 @@ def sac_loop(fabric: Any, cfg: Any, build_agent_fn: Any, critic_apply: Any) -> N
 
     profiler.close()
     envs.close()
+    if sentinel is not None:
+        sentinel.close()
     if getattr(rb, "spill", None) is not None:
         rb.spill.close()
     ckpt_mgr.finalize()

@@ -8,6 +8,7 @@ here for backwards compatibility.
 """
 
 from sheeprl_tpu.checkpoint.manager import CheckpointManager, resolve_auto_resume
+from sheeprl_tpu.checkpoint.rollback import rollback_state
 from sheeprl_tpu.checkpoint.preemption import (
     PREEMPTION_GUARD,
     PreemptionGuard,

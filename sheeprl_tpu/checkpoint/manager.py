@@ -188,6 +188,12 @@ class CheckpointManager:
     def latest(self) -> Optional[Path]:
         return latest_checkpoint(self.root)
 
+    def flush(self) -> None:
+        """Drain outstanding async saves WITHOUT finalizing (the rollback
+        path needs pending commits on disk, then keeps checkpointing)."""
+        if self._writer is not None:
+            self._writer.flush()
+
     # -- lifecycle -----------------------------------------------------------
     def finalize(self, timeout_s: Optional[float] = 300.0) -> None:
         """Drain outstanding async saves (idempotent; call before teardown)."""

@@ -1,0 +1,45 @@
+"""Rollback-to-last-committed-checkpoint (the health sentinels' restore path).
+
+The divergence detector (``resilience/health.py``) decides a run's params
+are garbage; this module answers "what do we restore?": the newest
+COMMITTED snapshot of the *current* run, CRC-verified before it is
+trusted (a rollback onto a bit-rotted snapshot would trade one kind of
+garbage for another).  Damaged snapshots are quarantined exactly like the
+resume path does and the next newest commit is tried.
+
+Only the current run's own checkpoint root is searched — a rollback must
+never silently jump to a *different* run's weights; when the run has no
+committed snapshot yet the caller surfaces :class:`~sheeprl_tpu.
+resilience.health.DivergenceError` instead, and the supervisor's
+restart-with-``resume_from=auto`` becomes the (cross-run) rollback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+
+def rollback_state(ckpt_mgr: Any, fabric: Any) -> Tuple[Optional[dict], Optional[Any]]:
+    """``(state, step_dir)`` of the newest intact committed snapshot of
+    this run, or ``(None, None)`` when none exists.
+
+    Drains the async writer first so a commit already snapshotted (the
+    usual case — the divergence window postdates the last cadence save)
+    is eligible rather than silently skipped mid-flight.
+    """
+    from sheeprl_tpu.checkpoint.protocol import verify_or_quarantine
+
+    ckpt_mgr.flush()
+    target = ckpt_mgr.latest()
+    while target is not None:
+        problems = verify_or_quarantine(target)
+        if not problems:
+            break
+        # quarantine renamed it step_*.corrupt (or failed on a read-only
+        # store — latest() would then return it again, so bail to None
+        # rather than spin); either way look again
+        nxt = ckpt_mgr.latest()
+        target = None if nxt == target else nxt
+    if target is None:
+        return None, None
+    return fabric.load(target), target

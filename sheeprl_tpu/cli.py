@@ -214,6 +214,9 @@ def run(argv: Optional[List[str]] = None) -> None:
     from sheeprl_tpu import telemetry
 
     telemetry.HUB.reset()
+    # a crashed loop never reached its sentinel teardown: drop the stale
+    # run-scoped Health/* source so it cannot leak into this run's flushes
+    telemetry.HUB.unregister("health")
     telemetry.RECORDER.clear()
     cfg = compose(argv)
     # arm (or explicitly clear) the fault-injection plan before anything

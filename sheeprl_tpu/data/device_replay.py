@@ -888,6 +888,7 @@ def fused_uniform_train(
     name: str,
     derive_next: Sequence[str] = (),
     max_recompiles: Optional[int] = None,
+    health: bool = False,
 ) -> Any:
     """Fold uniform index generation + device gather + ``prep`` + the algo's
     existing ``train_phase(p, o, batches, key, counter)`` into ONE
@@ -898,8 +899,32 @@ def fused_uniform_train(
     host-side per window) so a transfer-guarded steady state performs zero
     implicit H2D; ``n_samples`` is static — distinct window lengths compile
     distinct executables exactly as the shipped-batch path did (chunked by
-    :func:`update_chunks` for reuse)."""
+    :func:`update_chunks` for reuse).
+
+    ``health=True``: ``train_phase`` is a health-guarded program
+    (``resilience/health.py``) with the sentinel state threaded first —
+    the fused signature becomes ``fused(p, o, h, buffers, cursor, key,
+    counter, n_samples=U)`` → ``(p, o, h, counter + U, metrics)``, with
+    ``h`` donated alongside params/opt-state (device data like the
+    counter, so the guarded steady state stays one executable)."""
     import jax
+
+    if health:
+        def fused_h(p, o_state, h, buffers, cursor, k, counter, n_samples):
+            k_sample, k_train = jax.random.split(k)
+            batch = replay.sample_uniform(
+                buffers, cursor, k_sample, batch_size, int(n_samples), derive_next=derive_next
+            )
+            h, p, o_state, metrics = train_phase(h, p, o_state, prep(batch), k_train, counter)
+            return p, o_state, h, counter + int(n_samples), metrics
+
+        return fabric.compile(
+            fused_h,
+            name=name,
+            static_argnames=("n_samples",),
+            donate_argnums=(0, 1, 2),
+            max_recompiles=max_recompiles,
+        )
 
     def fused(p, o_state, buffers, cursor, k, counter, n_samples):
         k_sample, k_train = jax.random.split(k)
@@ -927,11 +952,31 @@ def fused_sequence_train(
     prep: Callable[[Dict[str, Any]], Dict[str, Any]],
     name: str,
     max_recompiles: Optional[int] = None,
+    health: bool = False,
 ) -> Any:
     """Sequence-sampling twin of :func:`fused_uniform_train` (the Dreamer
     family): ``fused(p, o, buffers, cursor, key, counter, n_samples=U)``
-    samples ``(U, L, B, *)`` blocks on device and runs the scanned update."""
+    samples ``(U, L, B, *)`` blocks on device and runs the scanned update.
+    ``health=True`` threads the sentinel state exactly like the uniform
+    variant."""
     import jax
+
+    if health:
+        def fused_h(p, o_state, h, buffers, cursor, k, counter, n_samples):
+            k_sample, k_train = jax.random.split(k)
+            blocks = replay.sample_sequences(
+                buffers, cursor, k_sample, batch_size, sequence_length, int(n_samples)
+            )
+            h, p, o_state, metrics = train_phase(h, p, o_state, prep(blocks), k_train, counter)
+            return p, o_state, h, counter + int(n_samples), metrics
+
+        return fabric.compile(
+            fused_h,
+            name=name,
+            static_argnames=("n_samples",),
+            donate_argnums=(0, 1, 2),
+            max_recompiles=max_recompiles,
+        )
 
     def fused(p, o_state, buffers, cursor, k, counter, n_samples):
         k_sample, k_train = jax.random.split(k)

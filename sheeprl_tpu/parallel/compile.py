@@ -176,6 +176,16 @@ class AOTFunction:
         """The underlying ``jax.jit`` function (implicit-compile semantics)."""
         return self._jitted
 
+    @property
+    def fn(self) -> Callable:
+        """The raw (unjitted, undonated) function.  Wrappers that trace this
+        program inside ANOTHER program and still use the original arguments
+        afterwards (the health guard's old-vs-new select) MUST trace this,
+        not the jitted callable: an inner jit's ``donate_argnums`` survives
+        inlining as an aliasing hint, so XLA may clobber a donated input's
+        buffer while the outer computation still reads it."""
+        return self._fn
+
     def lower(self, *args: Any, **kwargs: Any):
         return self._jitted.lower(*args, **kwargs)
 

@@ -11,6 +11,7 @@ no-op when empty); ``retry`` holds the liveness half — jittered-backoff
 from sheeprl_tpu.resilience.faults import (
     ENV_VAR,
     KNOWN_SITES,
+    TRACE_SITES,
     FaultPlan,
     FaultSpec,
     InjectedFault,
@@ -22,14 +23,23 @@ from sheeprl_tpu.resilience.faults import (
     install_from_env,
     install_plan,
 )
+from sheeprl_tpu.resilience.health import (
+    DivergenceError,
+    HealthSentinel,
+    HealthState,
+)
 from sheeprl_tpu.resilience.retry import CircuitBreaker, Watchdog, retry
 
 __all__ = [
     "ENV_VAR",
     "KNOWN_SITES",
+    "TRACE_SITES",
     "CircuitBreaker",
+    "DivergenceError",
     "FaultPlan",
     "FaultSpec",
+    "HealthSentinel",
+    "HealthState",
     "InjectedFault",
     "Watchdog",
     "active_plan",

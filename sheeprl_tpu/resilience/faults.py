@@ -54,9 +54,10 @@ KNOWN_SITES = (
     "replay.spill",
     "sebulba.env_worker",
     "sebulba.traj_queue",
+    "update.grads",
 )
 
-KINDS = ("raise", "hang", "latency", "corrupt", "truncate")
+KINDS = ("raise", "hang", "latency", "corrupt", "truncate", "nonfinite", "divergence")
 
 #: Sites whose hook passes a byte payload (``fault_bytes``) — the only
 #: legal targets for ``corrupt`` specs.
@@ -66,6 +67,19 @@ BYTE_SITES = ("checkpoint.write_shard",)
 #: tail-halves the queued rows (a torn spill write / a torn trajectory
 #: segment), not a byte payload.
 ROW_SITES = ("replay.spill", "sebulba.traj_queue")
+
+#: Sites whose faults are compiled INTO the train trace by the health
+#: sentinels (``resilience/health.py``) rather than polled host-side.
+#: ``nonfinite`` poisons the update's params/loss with NaN (what a NaN
+#: gradient does), ``divergence`` multiplies the loss the spike detector
+#: sees — both deterministically, at the spec's ``at``/``every`` guarded
+#: dispatch number, with ZERO per-step host involvement (the schedule is
+#: resolved at trace-build time, so the guarded executable stays one
+#: program and the transfer guard sees no extra H2D).  ``p`` schedules are
+#: rejected here: a host RNG draw per dispatch would need a per-step
+#: transfer.
+TRACE_SITES = ("update.grads",)
+TRACE_KINDS = ("nonfinite", "divergence")
 
 ENV_VAR = "SHEEPRL_FAULT_PLAN"
 
@@ -111,6 +125,21 @@ class FaultSpec:
             )
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind '{self.kind}' (known: {', '.join(KINDS)})")
+        if (self.kind in TRACE_KINDS) != (self.site in TRACE_SITES):
+            # same build-time philosophy as the corrupt/truncate checks: a
+            # trace-kind at a host site (or a host kind at the trace site)
+            # validates and then silently never acts — reject it loudly
+            raise ValueError(
+                f"fault kind '{self.kind}' and site '{self.site}' do not match: "
+                f"kinds {TRACE_KINDS} act only at the in-trace sites "
+                f"{TRACE_SITES} (and those sites accept only them)"
+            )
+        if self.site in TRACE_SITES:
+            if self.p is not None:
+                raise ValueError(
+                    f"fault site '{self.site}' is compiled into the train trace "
+                    "and only supports deterministic at=/every= schedules, not p="
+                )
         payload_sites = BYTE_SITES + ROW_SITES
         if self.kind == "corrupt" and self.site not in BYTE_SITES:
             # a byte fault at a value site would validate and then silently
@@ -238,6 +267,12 @@ class FaultPlan:
             return []
         with self._lock:
             return [s for s in specs if s.should_fire()]
+
+    def specs_for(self, site: str) -> List[FaultSpec]:
+        """Read-only view of the specs targeting ``site`` — NO counter
+        advance.  The health sentinels use this at trace-build time to
+        compile ``update.grads`` schedules into the guarded executable."""
+        return list(self._by_site.get(site, ()))
 
 
 # -- the process-global active plan ------------------------------------------

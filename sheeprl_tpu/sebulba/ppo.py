@@ -50,10 +50,11 @@ from sheeprl_tpu.sebulba.actor import ActorEngine, EnvWorker, FusedActor, Worker
 from sheeprl_tpu.sebulba.queues import ObsQueue, TrajQueue
 from sheeprl_tpu.sebulba.runner import (
     StatsSink,
+    arm_preemption,
     build_worker_fleet,
     clamp_queue_slots,
     collect_run_stats,
-    drain_segments,
+    drain_preemptible,
     shutdown,
 )
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
@@ -378,6 +379,25 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     HUB.register("sebulba.broadcast", broadcast.metrics)
     SPANS.roll_window()
 
+    arm_preemption(cfg)
+
+    def save_checkpoint() -> None:
+        # closure over the live loop variables: the cadence save and the
+        # preemption final save must write the identical state
+        fabric.call(
+            "on_checkpoint_player",
+            ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
+            state={
+                "agent": params,
+                "opt_state": opt_state,
+                "key": key,
+                "update": update,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+            },
+        )
+
     try:
         # inside the try: the first publish crosses fabric.copy_to (a
         # fault-injection site) — a throw here must still unregister
@@ -386,9 +406,16 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
             eng.start()
         if supervisor is not None:
             supervisor.start()
+        update = start_iter - 1
         for update in range(start_iter, total_iters + 1):
             with timer("Time/env_interaction_time"):
-                items = drain_segments(traj_queue, n_producers, engines, supervisor)
+                items = drain_preemptible(
+                    traj_queue, n_producers, engines, supervisor,
+                    ckpt_mgr=ckpt_mgr, fabric=fabric, policy_step=policy_step,
+                    save_checkpoint=save_checkpoint,
+                )
+            if items is None:  # preempted mid-wait: committed save done
+                break
             segs = tuple(item[0] for item in items)
             for _, meta in items:
                 lag = broadcast.version - int(meta.get("version", 0))
@@ -452,19 +479,7 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
 
             if ckpt_mgr.should_save(policy_step, last_checkpoint, final=update == total_iters):
                 last_checkpoint = policy_step
-                fabric.call(
-                    "on_checkpoint_player",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
-                    state={
-                        "agent": params,
-                        "opt_state": opt_state,
-                        "key": key,
-                        "update": update,
-                        "policy_step": policy_step,
-                        "last_log": last_log,
-                        "last_checkpoint": last_checkpoint,
-                    },
-                )
+                save_checkpoint()
             if ckpt_mgr.preempted:
                 fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
                 break

@@ -16,6 +16,15 @@ from sheeprl_tpu.telemetry.spans import SPANS, span
 from sheeprl_tpu.utils.env import make_env, vectorize
 
 
+class DrainPreempted(Exception):
+    """The SIGTERM/SIGINT preemption latch fired while the learner was
+    blocked on the trajectory queue.  The drivers catch this, run a final
+    SYNCHRONOUS committed save, and exit cleanly — a preempted split run
+    must not sit out the (up to 300 s) queue timeout eating into the
+    preemption grace window, nor die mid-wait with its progress
+    uncommitted."""
+
+
 class StatsSink:
     """Thread-safe episode-stats funnel (workers push, the learner drains
     into the metric aggregator at log time).  BOUNDED: with
@@ -109,17 +118,25 @@ def drain_segments(
     n: int,
     engines: List[Any],
     supervisor: Optional[WorkerSupervisor],
+    preempted: Optional[Callable[[], bool]] = None,
 ) -> List[Tuple[Dict[str, Any], Dict[str, Any]]]:
     """Pop ``n`` segments for one learner update, surfacing actor-engine
     failures and driving worker respawns while waiting — bounded by the
     queue's overall ``timeout_s`` so a wedged fused actor (which has no
-    supervisor) fails the run loudly instead of hanging it."""
+    supervisor) fails the run loudly instead of hanging it.
+
+    ``preempted`` (the drivers pass the checkpoint manager's rank-agreed
+    latch) is polled between queue waits: a latched SIGTERM raises
+    :class:`DrainPreempted` within one short wait (≤5 s) so the driver can
+    depose the workers and exit through its final committed save."""
     deadline = time.monotonic() + traj_queue.timeout_s
     # the learner's queue wait is ITS OWN phase (telemetry/spans.py): time
     # spent here is actor starvation, not rollout work — the queue.wait
     # fraction of the phase breakdown is what traj_queue_slots tuning reads
     with span("queue.wait"):
         while True:
+            if preempted is not None and preempted():
+                raise DrainPreempted()
             try:
                 return traj_queue.get_many(n, timeout_s=5.0)
             except TimeoutError:
@@ -133,6 +150,46 @@ def drain_segments(
                         f"trajectory queue produced < {n} segments in "
                         f"{traj_queue.timeout_s}s — actors wedged?"
                     )
+
+
+def arm_preemption(cfg: Any) -> None:
+    """Install the SIGTERM/SIGINT latch BEFORE the fleet starts: the
+    cadence poll (``should_save``) only runs after a full drain+update, and
+    a signal landing during the first (or any) queue wait must still be
+    caught — :func:`drain_preemptible` polls the latch for the drivers."""
+    from sheeprl_tpu.checkpoint import PREEMPTION_GUARD
+
+    if cfg.checkpoint.get("save_on_preemption", True):
+        PREEMPTION_GUARD.install()
+
+
+def drain_preemptible(
+    traj_queue: TrajQueue,
+    n: int,
+    engines: List[Any],
+    supervisor: Optional[WorkerSupervisor],
+    *,
+    ckpt_mgr: Any,
+    fabric: Any,
+    policy_step: int,
+    save_checkpoint: Callable[[], None],
+) -> Optional[List[Tuple[Dict[str, Any], Dict[str, Any]]]]:
+    """:func:`drain_segments` + the shared preemption exit (one copy for
+    both drivers): a latch fired mid-wait runs the driver's final
+    SYNCHRONOUS committed save (``ckpt_mgr.preempted`` forces the sync
+    path) and returns ``None`` — the caller breaks out of its round loop
+    and the normal teardown deposes the workers."""
+    try:
+        return drain_segments(
+            traj_queue, n, engines, supervisor, preempted=lambda: ckpt_mgr.preempted
+        )
+    except DrainPreempted:
+        fabric.print(
+            f"Preemption latched mid-drain: final committed save at "
+            f"step {policy_step}, exiting"
+        )
+        save_checkpoint()
+        return None
 
 
 def shutdown(

@@ -54,10 +54,11 @@ from sheeprl_tpu.sebulba.actor import ActorEngine, derive_ladder
 from sheeprl_tpu.sebulba.queues import ObsQueue, TrajQueue
 from sheeprl_tpu.sebulba.runner import (
     StatsSink,
+    arm_preemption,
     build_worker_fleet,
     clamp_queue_slots,
     collect_run_stats,
-    drain_segments,
+    drain_preemptible,
     shutdown,
 )
 from sheeprl_tpu.utils.env import episode_stats, final_obs_rows, make_env, vectorize
@@ -338,6 +339,29 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     HUB.register("sebulba.broadcast", broadcast.metrics)
     SPANS.roll_window()
 
+    arm_preemption(cfg)
+
+    def save_checkpoint() -> None:
+        # closure over the live loop variables: the cadence save and the
+        # preemption final save must write the identical state
+        fabric.call(
+            "on_checkpoint_player",
+            ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
+            state={
+                "agent": params,
+                "opt_state": opt_state,
+                "key": key,
+                "update": rnd,
+                "policy_step": policy_step,
+                "last_log": last_log,
+                "last_checkpoint": last_checkpoint,
+                "ratio": ratio.state_dict(),
+                "grad_steps": grad_step_counter,
+                "windows": windows,
+            },
+            replay_buffer=rb if cfg.buffer.checkpoint else None,
+        )
+
     try:
         # inside the try: the first publish crosses fabric.copy_to (a
         # fault-injection site) — a throw here must still unregister
@@ -345,9 +369,16 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
         for eng in engines:
             eng.start()
         supervisor.start()
+        rnd = start_round - 1
         for rnd in range(start_round, total_rounds + 1):
             with timer("Time/env_interaction_time"):
-                items = drain_segments(traj_queue, num_workers, engines, supervisor)
+                items = drain_preemptible(
+                    traj_queue, num_workers, engines, supervisor,
+                    ckpt_mgr=ckpt_mgr, fabric=fabric, policy_step=policy_step,
+                    save_checkpoint=save_checkpoint,
+                )
+            if items is None:  # preempted mid-wait: committed save done
+                break
             for seg, meta in items:
                 base = int(meta.get("worker", 0)) * envs_per_worker
                 rb.add(
@@ -426,23 +457,7 @@ def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
 
             if ckpt_mgr.should_save(policy_step, last_checkpoint, final=rnd == total_rounds):
                 last_checkpoint = policy_step
-                fabric.call(
-                    "on_checkpoint_player",
-                    ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_0.ckpt"),
-                    state={
-                        "agent": params,
-                        "opt_state": opt_state,
-                        "key": key,
-                        "update": rnd,
-                        "policy_step": policy_step,
-                        "last_log": last_log,
-                        "last_checkpoint": last_checkpoint,
-                        "ratio": ratio.state_dict(),
-                        "grad_steps": grad_step_counter,
-                        "windows": windows,
-                    },
-                    replay_buffer=rb if cfg.buffer.checkpoint else None,
-                )
+                save_checkpoint()
             if ckpt_mgr.preempted:
                 fabric.print(f"Preemption: committed checkpoint at step {policy_step}, exiting")
                 break

@@ -1,0 +1,47 @@
+"""Self-healing run supervision (docs/supervisor.md).
+
+``supervise`` owns the process-level loop — launch the training CLI as a
+child, heartbeat it through the PR 13 introspection endpoint, restart
+transient failures from the last committed checkpoint under a budget and
+backoff; ``classify`` owns the transient-vs-deterministic triage of each
+exit (the crash-loop circuit breaker's evidence).  The in-loop half of
+the robustness story — the training-health sentinels that skip poisoned
+updates and detect divergence *inside* the run — lives in
+``sheeprl_tpu.resilience.health``.
+"""
+
+from sheeprl_tpu.supervisor.classify import (
+    DETERMINISTIC,
+    DIVERGED,
+    PREEMPTED,
+    SUCCESS,
+    TRANSIENT,
+    Verdict,
+    classify,
+    crash_error,
+    load_postmortem,
+)
+from sheeprl_tpu.supervisor.supervise import (
+    EXIT_BREAKER,
+    EXIT_BUDGET,
+    EXIT_OK,
+    Supervisor,
+    main,
+)
+
+__all__ = [
+    "DETERMINISTIC",
+    "DIVERGED",
+    "EXIT_BREAKER",
+    "EXIT_BUDGET",
+    "EXIT_OK",
+    "PREEMPTED",
+    "SUCCESS",
+    "TRANSIENT",
+    "Supervisor",
+    "Verdict",
+    "classify",
+    "crash_error",
+    "load_postmortem",
+    "main",
+]

@@ -1,0 +1,7 @@
+"""``python -m sheeprl_tpu.supervisor`` — the ``sheeprl-tpu-supervise``
+entry point without an installed console script."""
+
+from sheeprl_tpu.supervisor.supervise import main
+
+if __name__ == "__main__":
+    main()

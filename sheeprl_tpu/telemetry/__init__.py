@@ -83,7 +83,9 @@ def setup_run(cfg: Any, log_dir: Optional[str], rank: int = 0) -> None:
         if port is None:
             return
         _SERVER = IntrospectionServer(
-            host=str(icfg.get("host", "127.0.0.1")), port=int(port)
+            host=str(icfg.get("host", "127.0.0.1")),
+            port=int(port),
+            stall_after_s=float(tcfg.get("stall_after_s", 600.0) or 0.0),
         ).start()
     # flush: harnesses (run_ci stage 12) parse this line off a pipe while
     # the run itself may not print again for minutes

@@ -73,8 +73,12 @@ class IntrospectionServer:
     construction.  The server thread is a daemon — it must never keep a
     finished training process alive."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, stall_after_s: float = 0.0):
         self._started_at = time.time()
+        #: /healthz reports ``stalled: true`` (HTTP 503) when the newest
+        #: completed update dispatch is older than this (0 = detection off).
+        #: Set from ``telemetry.stall_after_s`` by ``telemetry.setup_run``.
+        self.stall_after_s = float(stall_after_s or 0.0)
         self._httpd = ThreadingHTTPServer((host, int(port)), _make_handler(self))
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
@@ -142,10 +146,27 @@ def _make_handler(server: IntrospectionServer):
                 parsed = urlparse(self.path)
                 path = parsed.path.rstrip("/") or "/"
                 if path == "/healthz":
+                    # liveness detail (ISSUE 14): how stale is the training
+                    # loop?  `last_update_age_s` is seconds since the newest
+                    # COMPLETED update dispatch (null before the first one —
+                    # warm-up compiles are not a stall); past
+                    # telemetry.stall_after_s the probe flips `stalled` and
+                    # answers 503, so the supervisor and k8s-style external
+                    # probes can tell hung from healthy without killing blind.
+                    age = SPANS.last_update_age_s()
+                    stalled = bool(
+                        server.stall_after_s > 0
+                        and age is not None
+                        and age > server.stall_after_s
+                    )
                     self._reply_json(
-                        200,
+                        503 if stalled else 200,
                         {
-                            "ok": True,
+                            "ok": not stalled,
+                            "stalled": stalled,
+                            "last_update_age_s": None if age is None else round(age, 3),
+                            "updates_done": SPANS.updates_done,
+                            "stall_after_s": server.stall_after_s,
                             "pid": os.getpid(),
                             "uptime_s": round(server.uptime_s, 3),
                             "run_dir": RECORDER.run_dir,

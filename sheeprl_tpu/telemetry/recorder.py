@@ -103,6 +103,15 @@ class FlightRecorder:
             "events": self.snapshot(),
         }
         try:
+            # the newest policy step the hub flushed at: the supervisor's
+            # failure classifier keys its fatal signature on (error, step) —
+            # the same crash at the same step twice is deterministic
+            from sheeprl_tpu.telemetry.hub import HUB
+
+            doc["last_step"] = int(HUB.last_step)
+        except Exception:
+            doc["last_step"] = None
+        try:
             from sheeprl_tpu.telemetry.monitors import (
                 CHECKPOINT_MONITOR,
                 COMPILE_MONITOR,

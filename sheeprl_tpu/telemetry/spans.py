@@ -75,6 +75,12 @@ class SpanTracker:
         self._excl: Dict[str, float] = {}
         self._counts: Dict[str, int] = {}
         self._window_start = _now()
+        # liveness signal for /healthz (introspect.py): wall time of the
+        # newest COMPLETED top-level update.dispatch span + total count —
+        # survives window rolls, so a stalled learner is visible however
+        # long it has been wedged
+        self._last_update_done: Optional[float] = None
+        self._updates_done = 0
 
     # -- configuration -------------------------------------------------------
     def configure(self, cfg: Any = None) -> None:
@@ -136,6 +142,10 @@ class SpanTracker:
                 # top-level span edges are flight-recorder events (bounded
                 # ring — per-update cadence, not per-env-step)
                 RECORDER.record("span", name=span.name, seconds=round(dur, 6))
+                if span.name == "update.dispatch":
+                    with self._lock:
+                        self._last_update_done = time.time()
+                        self._updates_done += 1
             if span is token:
                 return
 
@@ -149,6 +159,21 @@ class SpanTracker:
 
     def depth(self) -> int:
         return len(self._stack())
+
+    # -- liveness ------------------------------------------------------------
+    def last_update_age_s(self) -> Optional[float]:
+        """Seconds since the newest completed update dispatch (None before
+        the first one — warm-up compiles can legitimately take many
+        minutes, so pre-first-update runs are never called stalled)."""
+        with self._lock:
+            if self._last_update_done is None:
+                return None
+            return max(0.0, time.time() - self._last_update_done)
+
+    @property
+    def updates_done(self) -> int:
+        with self._lock:
+            return self._updates_done
 
     # -- window aggregation --------------------------------------------------
     def breakdown(self) -> Dict[str, Any]:
@@ -203,6 +228,9 @@ class SpanTracker:
         self.roll_window()
         self.enabled = True
         self.sync = False
+        with self._lock:
+            self._last_update_done = None
+            self._updates_done = 0
 
 
 #: The process-global span tracker.

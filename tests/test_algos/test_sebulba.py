@@ -322,3 +322,61 @@ class TestChaosDrills:
         assert stats["worker_restarts"] >= 1
         assert stats["updates"] == 6
         assert stats["torn_rejected"] == 0
+
+
+class TestPreemption:
+    def test_sigterm_mid_run_drains_and_commits_final_save(self, tmp_path):
+        # ISSUE 14 satellite: the sebulba path must honor the preemption
+        # latch — a SIGTERM landing while the learner waits on the
+        # trajectory queue (the drain loop polls the latch) must depose the
+        # workers, exit through a final COMMITTED save, and return cleanly
+        # instead of waiting out the queue timeout or dying uncommitted.
+        import glob as _glob
+        import os as _os
+        import signal as _signal
+
+        from sheeprl_tpu.checkpoint import PREEMPTION_GUARD
+        from sheeprl_tpu.checkpoint.protocol import checkpoint_step
+        from sheeprl_tpu.telemetry.spans import SPANS
+
+        base_updates = SPANS.updates_done
+        stop = threading.Event()
+
+        def preempt_after_progress():
+            # latch only once the learner has really trained (>=2 update
+            # dispatches), so the drill exercises mid-run preemption, not
+            # startup
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline and not stop.is_set():
+                if SPANS.updates_done >= base_updates + 2:
+                    _os.kill(_os.getpid(), _signal.SIGTERM)
+                    return
+                time.sleep(0.05)
+
+        killer = threading.Thread(target=preempt_after_progress, daemon=True)
+        killer.start()
+        try:
+            stats = _run_sebulba_ppo(
+                tmp_path,
+                extra=[
+                    # long enough that only preemption can end the run early
+                    "algo.total_steps=100000",
+                    "checkpoint.every=0",
+                    "checkpoint.save_last=True",
+                ],
+            )
+        finally:
+            stop.set()
+            killer.join(5)
+            PREEMPTION_GUARD.reset()
+        # the run ended EARLY and cleanly (no queue-timeout, no crash)
+        assert stats["updates"] < 100000 // 16
+        assert stats["updates"] >= 2
+        # ...and left a COMMITTED snapshot at a real (post-progress) step
+        steps = [
+            checkpoint_step(p)
+            for p in _glob.glob(f"{tmp_path}/logs/**/checkpoint/step_*", recursive=True)
+        ]
+        committed = [s for s in steps if s >= 0]
+        assert committed, f"no committed snapshot (found {steps})"
+        assert max(committed) >= 16 * 2  # at least two rounds' progress

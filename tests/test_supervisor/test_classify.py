@@ -1,0 +1,116 @@
+"""Unit tests for the supervisor's failure triage
+(sheeprl_tpu/supervisor/classify.py): transient infra restarts, the same
+fatal step twice is deterministic, malformed postmortems degrade safely."""
+
+import json
+
+from sheeprl_tpu.supervisor.classify import (
+    DETERMINISTIC,
+    DIVERGED,
+    PREEMPTED,
+    SUCCESS,
+    TRANSIENT,
+    classify,
+    crash_error,
+    load_postmortem,
+)
+
+
+def _pm(reason="exception", error="InjectedFault: boom", last_step=37, **extra):
+    doc = {
+        "schema": "sheeprl.postmortem/1",
+        "reason": reason,
+        "last_step": last_step,
+        "events": [{"kind": "span"}, {"kind": "crash", "error": error}],
+    }
+    doc.update(extra)
+    return doc
+
+
+class TestVerdicts:
+    def test_clean_exit_is_success(self):
+        v = classify(0, None)
+        assert v.kind == SUCCESS and not v.restartable
+
+    def test_kill_9_is_transient_without_signature(self):
+        # "kill -9 => restart": a signal death carries NO fatal signature,
+        # so it can never open the breaker, only burn the budget
+        v = classify(-9, None)
+        assert v.kind == TRANSIENT and v.restartable
+        assert v.signature is None
+        assert "SIGKILL" in v.reason
+
+    def test_hang_overrides_exit_status(self):
+        # a watchdog-SIGTERM'd child often exits 0 through its preemption
+        # save — the supervisor's own hang verdict must win
+        v = classify(0, _pm(), hung=True)
+        assert v.kind == TRANSIENT
+        assert v.signature == ("hang", 37)
+
+    def test_exception_carries_fatal_signature(self):
+        v = classify(1, _pm())
+        assert v.kind == TRANSIENT and v.restartable
+        assert v.signature == ("InjectedFault: boom", 37)
+
+    def test_preemption_is_restartable_without_signature(self):
+        v = classify(1, _pm(reason="preemption"))
+        assert v.kind == PREEMPTED and v.restartable
+        assert v.signature is None
+
+    def test_preempted_child_exiting_zero_is_not_success(self):
+        # the latch makes a preempted run exit 0 through its final
+        # committed save — the preemption postmortem must win over the
+        # clean exit status, or the supervisor reports an incomplete run
+        # as done and never restarts it
+        v = classify(0, _pm(reason="preemption"))
+        assert v.kind == PREEMPTED and v.restartable
+        # ...while a genuinely completed run (no fresh postmortem) stays
+        # success
+        assert classify(0, None).kind == SUCCESS
+
+    def test_divergence_is_flagged_and_signed(self):
+        v = classify(1, _pm(error="DivergenceError: diverged at step 99", last_step=99))
+        assert v.kind == DIVERGED and v.restartable
+        assert v.signature == ("DivergenceError: diverged at step 99", 99)
+
+    def test_missing_postmortem_is_transient_unsigned(self):
+        v = classify(1, None)
+        assert v.kind == TRANSIENT and v.signature is None
+        assert "missing/malformed" in v.reason
+
+    def test_classify_never_emits_deterministic_itself(self):
+        # DETERMINISTIC is the supervisor's breaker decision (signature
+        # repetition), not a single-episode verdict
+        for v in (classify(1, _pm()), classify(-9, None), classify(1, None)):
+            assert v.kind != DETERMINISTIC
+
+
+class TestPostmortemParsing:
+    def test_load_missing(self, tmp_path):
+        assert load_postmortem(None) is None
+        assert load_postmortem(str(tmp_path / "nope.json")) is None
+
+    def test_load_malformed_json(self, tmp_path):
+        p = tmp_path / "postmortem.json"
+        p.write_text("{ not json")
+        assert load_postmortem(str(p)) is None
+
+    def test_load_wrong_schema(self, tmp_path):
+        p = tmp_path / "postmortem.json"
+        p.write_text(json.dumps({"schema": "other/1", "reason": "exception"}))
+        assert load_postmortem(str(p)) is None
+
+    def test_load_roundtrip(self, tmp_path):
+        p = tmp_path / "postmortem.json"
+        p.write_text(json.dumps(_pm()))
+        doc = load_postmortem(str(p))
+        assert doc is not None and doc["reason"] == "exception"
+
+    def test_crash_error_picks_newest_crash_event(self):
+        doc = _pm()
+        doc["events"].append({"kind": "crash", "error": "second"})
+        assert crash_error(doc) == "second"
+
+    def test_crash_error_absent(self):
+        assert crash_error({"events": [{"kind": "span"}]}) is None
+        assert crash_error({}) is None

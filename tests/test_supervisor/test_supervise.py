@@ -1,0 +1,262 @@
+"""Supervisor loop tests over scripted child processes (no training):
+restart-on-transient, breaker-on-repeat, budget exhaustion, hang watchdog.
+
+Each test builds a tiny ``python -c`` child that crashes/hangs/succeeds on
+cue (a marker file counts launches) and writes postmortem.json where a
+real run would (``<log_dir>/<root_dir>/<run>/version_0/``)."""
+
+import json
+import os
+import sys
+import textwrap
+
+import pytest
+
+from sheeprl_tpu.supervisor import (
+    EXIT_BREAKER,
+    EXIT_BUDGET,
+    EXIT_OK,
+    Supervisor,
+)
+from sheeprl_tpu.utils.structured import dotdict
+
+
+def make_supervisor(tmp_path, child_script, scfg=None, argv=None):
+    cfg = dotdict(
+        {
+            "supervisor": {
+                "max_restarts": 3,
+                "backoff_base_s": 0.01,
+                "poll_interval_s": 0.1,
+                "kill_grace_s": 5.0,
+                "introspect": False,
+                **(scfg or {}),
+            },
+            "log_dir": str(tmp_path),
+            "root_dir": "exp",
+        }
+    )
+    return Supervisor(
+        cfg,
+        list(argv or ["exp=fake"]),
+        child_cmd=lambda child_argv: [sys.executable, "-c", child_script, *child_argv],
+        handle_signals=False,
+    )
+
+
+def episodes_of(sup):
+    with open(sup.audit_path) as f:
+        return [json.loads(line) for line in f]
+
+
+def child_source(tmp_path, body):
+    """A child script with RUN counting + postmortem helpers in scope."""
+    return textwrap.dedent(
+        f"""
+        import json, os, sys, time
+        ROOT = {str(tmp_path)!r}
+        MARKER = os.path.join(ROOT, "launches")
+        launches = int(open(MARKER).read()) if os.path.exists(MARKER) else 0
+        open(MARKER, "w").write(str(launches + 1))
+
+        def write_postmortem(doc, run="run_a"):
+            run_dir = os.path.join(ROOT, "exp", run, "version_0")
+            os.makedirs(run_dir, exist_ok=True)
+            with open(os.path.join(run_dir, "postmortem.json"), "w") as f:
+                if isinstance(doc, str):
+                    f.write(doc)
+                else:
+                    json.dump(doc, f)
+        """
+    ) + textwrap.dedent(body)
+
+
+PM_CRASH = (
+    '{"schema": "sheeprl.postmortem/1", "reason": "exception", "last_step": 37,'
+    ' "events": [{"kind": "crash", "error": "InjectedFault: boom"}]}'
+)
+
+
+class TestRestart:
+    def test_crash_once_then_succeed(self, tmp_path):
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                f"""
+                if launches == 0:
+                    write_postmortem({PM_CRASH!r})
+                    sys.exit(1)
+                sys.exit(0)
+                """,
+            ),
+        )
+        assert sup.run() == EXIT_OK
+        eps = episodes_of(sup)
+        assert [e["classification"] for e in eps] == ["transient", "success"]
+        assert eps[0]["action"] == "restart" and eps[1]["action"] == "done"
+
+    def test_restart_forces_auto_resume(self, tmp_path):
+        out = tmp_path / "argv.json"
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                f"""
+                if launches == 0:
+                    sys.exit(1)
+                json.dump(sys.argv[1:], open({str(out)!r}, "w"))
+                sys.exit(0)
+                """,
+            ),
+            argv=["exp=fake", "algo.total_steps=64"],
+        )
+        assert sup.run() == EXIT_OK
+        relaunch_argv = json.load(open(out))
+        # user argv preserved, resume appended LAST so it wins composition
+        assert relaunch_argv[0] == "exp=fake"
+        assert relaunch_argv[-1] == "checkpoint.resume_from=auto"
+
+    def test_preempted_child_restarts_despite_rc_zero(self, tmp_path):
+        # external preemption: the child exits 0 through its final save
+        # and leaves a reason=preemption postmortem — the supervisor must
+        # resume it, not call the run done
+        pm = (
+            '{"schema": "sheeprl.postmortem/1", "reason": "preemption",'
+            ' "last_step": 20, "events": []}'
+        )
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                f"""
+                if launches == 0:
+                    write_postmortem({pm!r})
+                    sys.exit(0)
+                sys.exit(0)
+                """,
+            ),
+        )
+        assert sup.run() == EXIT_OK
+        eps = episodes_of(sup)
+        assert [e["classification"] for e in eps] == ["preempted", "success"]
+        assert eps[0]["action"] == "restart"
+
+    def test_kill_9_restarts(self, tmp_path):
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                """
+                if launches == 0:
+                    os.kill(os.getpid(), 9)
+                sys.exit(0)
+                """,
+            ),
+        )
+        assert sup.run() == EXIT_OK
+        eps = episodes_of(sup)
+        assert eps[0]["returncode"] == -9
+        assert eps[0]["classification"] == "transient"
+        assert eps[0]["signature"] is None  # signals never open the breaker
+        assert eps[1]["classification"] == "success"
+
+
+class TestBreaker:
+    def test_same_fatal_signature_twice_opens_breaker(self, tmp_path):
+        # deterministic crash: identical (error, last_step) every episode —
+        # the breaker must stop after breaker_threshold=2, NOT burn the
+        # whole restart budget (max_restarts=3)
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                f"""
+                write_postmortem({PM_CRASH!r}, run="run_%d" % launches)
+                sys.exit(1)
+                """,
+            ),
+        )
+        assert sup.run() == EXIT_BREAKER
+        eps = episodes_of(sup)
+        assert len(eps) == 2
+        assert eps[0]["classification"] == "transient"
+        assert eps[1]["classification"] == "deterministic"
+        assert "circuit breaker open" in eps[1]["reason"]
+        # the postmortem reason is surfaced in the verdict chain
+        assert eps[1]["signature"] == ["InjectedFault: boom", 37]
+
+    def test_different_steps_do_not_open_breaker(self, tmp_path):
+        # same error string but the fatal step ADVANCES (the resume made
+        # progress): transient every time, bounded by the budget instead
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                """
+                doc = {"schema": "sheeprl.postmortem/1", "reason": "exception",
+                       "last_step": 10 * (launches + 1),
+                       "events": [{"kind": "crash", "error": "InjectedFault: boom"}]}
+                write_postmortem(doc, run="run_%d" % launches)
+                sys.exit(1)
+                """,
+            ),
+            scfg={"max_restarts": 2},
+        )
+        assert sup.run() == EXIT_BUDGET
+        eps = episodes_of(sup)
+        assert [e["classification"] for e in eps] == ["transient"] * 3
+        assert eps[-1]["action"] == "budget-exhausted"
+
+
+class TestBudget:
+    def test_malformed_postmortem_is_transient_with_budget(self, tmp_path):
+        # a child that dies without intelligible evidence (OOM-killer,
+        # segfault before the dump): restart, but under the budget — and
+        # never the breaker (no signature to repeat)
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                """
+                write_postmortem("{ not json", run="run_%d" % launches)
+                sys.exit(1)
+                """,
+            ),
+            scfg={"max_restarts": 2},
+        )
+        assert sup.run() == EXIT_BUDGET
+        eps = episodes_of(sup)
+        assert len(eps) == 3  # initial + 2 restarts
+        assert all(e["classification"] == "transient" for e in eps)
+        assert all(e["signature"] is None for e in eps)
+
+
+class TestHangWatchdog:
+    @pytest.mark.slow
+    def test_silent_child_is_killed_and_restarted(self, tmp_path):
+        # introspect armed but the child never prints a URL: the
+        # first-heartbeat timeout declares a hang, SIGTERM lands (the
+        # sleeping child dies with -15), the relaunch succeeds
+        sup = make_supervisor(
+            tmp_path,
+            child_source(
+                tmp_path,
+                """
+                if launches == 0:
+                    time.sleep(300)
+                sys.exit(0)
+                """,
+            ),
+            scfg={
+                "introspect": True,
+                "first_heartbeat_timeout_s": 1.0,
+                "poll_interval_s": 0.2,
+                "kill_grace_s": 5.0,
+            },
+        )
+        assert sup.run() == EXIT_OK
+        eps = episodes_of(sup)
+        assert eps[0]["hung"] is True
+        assert eps[0]["classification"] == "transient"
+        assert eps[1]["classification"] == "success"

@@ -1,0 +1,92 @@
+"""/healthz liveness detail (ISSUE 14): last_update_age_s + stalled flag,
+HTTP 503 when the update stream stalls — so the supervisor and k8s-style
+probes can tell hung from healthy without killing blind."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from sheeprl_tpu.telemetry import SPANS, IntrospectionServer
+
+
+def fetch_healthz(url):
+    try:
+        with urllib.request.urlopen(url + "/healthz", timeout=5) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read().decode())
+
+
+def tick_update():
+    with SPANS.span("update.dispatch"):
+        pass
+
+
+class TestLiveness:
+    def test_before_first_update_never_stalled(self):
+        # warm-up compiles can take many minutes: a run that has not yet
+        # completed an update is NOT stalled, however small the threshold
+        with IntrospectionServer(stall_after_s=0.001) as srv:
+            time.sleep(0.05)
+            status, body = fetch_healthz(srv.url)
+            assert status == 200
+            assert body["ok"] is True and body["stalled"] is False
+            assert body["last_update_age_s"] is None
+            assert body["updates_done"] == 0
+
+    def test_fresh_update_is_healthy(self):
+        with IntrospectionServer(stall_after_s=30.0) as srv:
+            tick_update()
+            status, body = fetch_healthz(srv.url)
+            assert status == 200 and body["stalled"] is False
+            assert body["updates_done"] == 1
+            assert 0.0 <= body["last_update_age_s"] < 30.0
+
+    def test_stalled_run_answers_503(self):
+        with IntrospectionServer(stall_after_s=0.1) as srv:
+            tick_update()
+            time.sleep(0.25)
+            status, body = fetch_healthz(srv.url)
+            assert status == 503
+            assert body["ok"] is False and body["stalled"] is True
+            assert body["last_update_age_s"] > 0.1
+            # a new update clears the stall — hung vs slow is re-decided
+            # per probe, never latched
+            tick_update()
+            status, body = fetch_healthz(srv.url)
+            assert status == 200 and body["stalled"] is False
+            assert body["updates_done"] == 2
+
+    def test_detection_disabled_with_zero_threshold(self):
+        with IntrospectionServer(stall_after_s=0.0) as srv:
+            tick_update()
+            time.sleep(0.05)
+            status, body = fetch_healthz(srv.url)
+            assert status == 200 and body["stalled"] is False
+
+    def test_config_plumbs_threshold(self):
+        # telemetry.setup_run wires telemetry.stall_after_s into the server
+        from sheeprl_tpu import telemetry
+        from sheeprl_tpu.utils.structured import dotdict
+
+        cfg = dotdict(
+            {"telemetry": {"stall_after_s": 7.5, "introspect": {"port": 0}}}
+        )
+        telemetry.setup_run(cfg, None)
+        try:
+            srv = telemetry.introspection_server()
+            assert srv is not None and srv.stall_after_s == 7.5
+        finally:
+            telemetry.shutdown_run()
+
+    def test_nested_dispatch_spans_do_not_tick(self):
+        # only TOP-LEVEL update.dispatch spans are update completions (the
+        # tracer's tick contract) — liveness must count the same stream
+        before = SPANS.updates_done
+        with SPANS.span("rollout"):
+            with SPANS.span("update.dispatch"):
+                pass
+        assert SPANS.updates_done == before
