@@ -74,6 +74,12 @@ def _bench_stamp(target: str) -> dict:
             "python": platform.python_version(),
         },
     }
+    if target == "lint":
+        # graftlint is pure host AST work and deliberately skips the bench
+        # watchdog — a jax.devices() probe here could hang on a half-wedged
+        # TPU tunnel with nothing left to kill it
+        stamp["devices"] = None
+        return stamp
     try:
         import jax
 
@@ -1312,8 +1318,45 @@ def bench_health_overhead() -> dict:
     }
 
 
+def bench_lint() -> dict:
+    """graftlint wall-time gate (``--mode lint``, ISSUE 15).
+
+    Times the whole-package static-analysis run (the run_ci stage 14 /
+    tier-1 workload) and gates it like any other perf surface: findings
+    mean the repo broke the zero-unsuppressed invariant, stale baseline
+    entries mean a fixed finding kept its ledger entry, and a >60 s wall
+    means the analyzer outgrew its CI budget.  Pure host work — no jax
+    dispatch, no accelerator involvement."""
+    from sheeprl_tpu.analysis import Baseline, DEFAULT_BASELINE, run_analysis
+
+    t0 = time.perf_counter()
+    report = run_analysis(baseline=Baseline.load(DEFAULT_BASELINE))
+    wall = time.perf_counter() - t0
+
+    budget_s = float(os.environ.get("BENCH_LINT_BUDGET_S", 60.0))
+    gate_failed = bool(
+        report.findings or report.stale_baseline or wall > budget_s
+    )
+    return {
+        "metric": f"graftlint_wall (whole sheeprl_tpu/, {report.files_analyzed} files)",
+        "value": round(wall, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "files_analyzed": report.files_analyzed,
+        "unsuppressed_findings": len(report.findings),
+        "findings_by_rule": report.counts(),
+        "baselined": len(report.baselined),
+        "comment_suppressed": len(report.suppressed),
+        "stale_baseline_entries": len(report.stale_baseline),
+        "budget_s": budget_s,
+        "gate_failed": gate_failed,
+    }
+
+
 def _run_bench() -> dict:
     target = os.environ.get("BENCH_TARGET", "dreamer_v3")
+    if target == "lint":
+        return bench_lint()
     if target == "serve":
         return bench_serve()
     if target == "replay":
@@ -1444,7 +1487,12 @@ if __name__ == "__main__":
 
     from sheeprl_tpu.utils.utils import force_cpu_backend
 
-    if os.environ.get("BENCH_CHILD") == "1" or os.environ.get("JAX_PLATFORMS") == "cpu":
+    if (
+        os.environ.get("BENCH_CHILD") == "1"
+        or os.environ.get("JAX_PLATFORMS") == "cpu"
+        # graftlint is pure host AST work: never probe the accelerator for it
+        or os.environ.get("BENCH_TARGET") == "lint"
+    ):
         # child (or explicit CPU request): run the bench body directly
         if os.environ.get("JAX_PLATFORMS") == "cpu":
             # the TPU plugin overrides the env var; jax.config wins

@@ -1,0 +1,112 @@
+"""CLI for graftlint: ``sheeprl-tpu-lint`` / ``python -m sheeprl_tpu.analysis``.
+
+Exit codes: 0 = clean (no unsuppressed findings; under ``--strict`` also no
+stale baseline entries), 1 = findings, 2 = usage/internal error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="sheeprl-tpu-lint",
+        description=(
+            "graftlint: static analysis of JAX-law invariants (donation, "
+            "trace purity, PRNG discipline, config/fault-site/metric "
+            "registries). docs/static_analysis.md has the rule catalogue."
+        ),
+    )
+    parser.add_argument(
+        "paths", nargs="*",
+        help="files/directories to analyze (default: the sheeprl_tpu package)",
+    )
+    parser.add_argument(
+        "--strict", action="store_true",
+        help="also fail on stale baseline entries (the CI spelling)",
+    )
+    parser.add_argument(
+        "--baseline", default=None,
+        help="baseline file (default: sheeprl_tpu/analysis/baseline.json)",
+    )
+    parser.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline (show every finding)",
+    )
+    parser.add_argument(
+        "--select", default=None,
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument(
+        "--format", choices=("text", "json"), default="text", dest="fmt",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalogue and exit",
+    )
+    parser.add_argument(
+        "--write-baseline", metavar="REASON", default=None,
+        help=(
+            "regenerate the baseline from current unsuppressed findings, "
+            "stamping REASON on new entries (bootstrap helper — edit the "
+            "reasons before committing)"
+        ),
+    )
+    parser.add_argument(
+        "-v", "--verbose", action="store_true", help="also list baselined findings",
+    )
+    args = parser.parse_args(argv)
+
+    from sheeprl_tpu.analysis.baseline import DEFAULT_BASELINE, Baseline, BaselineError
+    from sheeprl_tpu.analysis.core import RULE_IDS, run_analysis
+
+    if args.list_rules:
+        for rule, desc in RULE_IDS.items():
+            print(f"{rule:26s} {desc}")
+        return 0
+
+    baseline = None
+    if not args.no_baseline and args.write_baseline is None:
+        path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+        if path.is_file():
+            try:
+                baseline = Baseline.load(path)
+            except (BaselineError, ValueError) as e:
+                print(f"graftlint: invalid baseline {path}: {e}", file=sys.stderr)
+                return 2
+
+    select = [r.strip() for r in args.select.split(",")] if args.select else None
+    try:
+        report = run_analysis(
+            args.paths or None, select=select, baseline=baseline,
+        )
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline is not None:
+        path = Path(args.baseline) if args.baseline else DEFAULT_BASELINE
+        Baseline.write(report.findings, path, args.write_baseline)
+        print(
+            f"graftlint: wrote {len(report.findings)} finding(s) to {path} — "
+            "edit the reasons before committing"
+        )
+        return 0
+
+    if args.fmt == "json":
+        print(json.dumps(report.to_dict(), indent=2))
+    else:
+        print(report.render_text(verbose=args.verbose))
+
+    if report.findings:
+        return 1
+    if args.strict and report.stale_baseline:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,385 @@
+"""Rule family 1: buffer donation.
+
+``use-after-donate`` — the bug class behind the two worst shipped bugs:
+
+* **PR 7**: same-platform ``copy_to`` returned a zero-copy *alias* of the
+  params; the next donated train dispatch deleted the player's copy
+  ("buffer has been deleted or donated").
+* **PR 14**: ``HealthSentinel.wrap`` traced the *jitted* (donating)
+  callable inside another program and then re-read the original arguments
+  for its old-vs-new select — the inner ``donate_argnums`` survives
+  inlining as an aliasing hint, so XLA may clobber the donated input while
+  the outer computation still reads it.  Sibling facet: the zero
+  ``HealthState`` was built by ``jax.device_put`` of numpy scalars; on CPU
+  ``device_put`` can zero-copy *borrow* the numpy buffer, so donating it
+  hands XLA memory it does not own (intermittent heap corruption).
+
+Static model, per scope (module / function), donated-callable tables
+inherited by nested scopes:
+
+* A name bound from ``fabric.compile(f, donate_argnums=...)`` /
+  ``compile_once(...)`` / ``jax.jit(...)`` / ``fabric.jit(...)`` with a
+  literal ``donate_argnums`` is a *donating callable*.  Factories that
+  return donating callables are propagated intra-module (``make_*`` that
+  ``return``s donating names), plus a curated table for the framework's
+  cross-module fused builders.
+* Calling a donating callable donates every plain-``Name`` argument in a
+  donated position (``x.copy()`` at the call site opts out), together with
+  that name's un-copied aliases (``y = x``, ``y = copy_to(x, ...)``,
+  ``y = jax.device_put(x, ...)``).
+* Reading a donated name afterwards — without rebinding it first — is the
+  finding.  Rebinding in the same statement (``p, o = step(p, o)``) is the
+  canonical safe shape.  Loops are scanned twice so a donation in one
+  iteration reaches reads at the top of the next.
+
+``donation-borrowed-buffer`` — a value built by ``jax.device_put`` of a
+numpy expression passed in a donated position (the PR 14 sibling facet).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.core import (
+    Finding,
+    FlowState,
+    SourceFile,
+    assigned_names,
+    attr_chain,
+    call_name,
+    flow_scan,
+    literal_int_tuple,
+)
+
+#: wrappers that produce a donating callable from ``fn`` at arg 0 when
+#: called with donate_argnums
+_COMPILE_WRAPPERS = ("compile", "compile_once", "jit")
+
+#: cross-module factories known to return donating callables and which of
+#: the RETURNED callable's positional args are donated.  Kept conservative:
+#: the fused replay builders donate (params, opt_state) in every variant
+#: (the health variant also donates the sentinel state at position 2, but
+#: flagging 0/1 is enough to catch the bug class without risking noise).
+KNOWN_FACTORY_DONATIONS: Dict[str, Tuple[int, ...]] = {
+    "fused_uniform_train": (0, 1),
+    "fused_sequence_train": (0, 1),
+}
+
+#: callables whose result may ALIAS their first argument (the PR 7 class:
+#: same-platform copy_to / device_put can be zero-copy)
+_ALIAS_HAZARDS = ("copy_to", "device_put", "to_host")
+
+
+def check(src: SourceFile, ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    # donating callables returned by local factories, discovered first so
+    # call sites anywhere in the module see them
+    factory_table = dict(KNOWN_FACTORY_DONATIONS)
+    factory_table.update(_local_factory_donations(src.tree))
+    _scan_scope(
+        src, src.tree.body, {}, factory_table, findings, context="module",
+    )
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# factory propagation
+# ---------------------------------------------------------------------------
+
+def _donating_callable_argnums(value: ast.expr) -> Optional[Tuple[int, ...]]:
+    """``compile_once(f, donate_argnums=(0, 1))``-shaped expression ->
+    (0, 1); None otherwise."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = call_name(value)
+    if name not in _COMPILE_WRAPPERS:
+        return None
+    for kw in value.keywords:
+        if kw.arg == "donate_argnums":
+            nums = literal_int_tuple(kw.value)
+            if nums:
+                return nums
+    return None
+
+
+def _local_factory_donations(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """Functions in this module that RETURN donating callables.
+
+    Only the simple single-return shape is propagated: the factory binds
+    ``f = compile_once(..., donate_argnums=...)`` and ends with
+    ``return f`` or ``return a, f`` — the caller's tuple unpacking then
+    maps positionally (``act_fn, train_phase = make_sac_train_fns(...)``).
+    Multi-position returns map each donating element.
+    """
+    out: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        donating: Dict[str, Tuple[int, ...]] = {}
+        for stmt in ast.walk(node):
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                t = stmt.targets[0]
+                nums = _donating_callable_argnums(stmt.value)
+                if nums and isinstance(t, ast.Name):
+                    donating[t.id] = nums
+        if not donating:
+            continue
+        for stmt in ast.walk(node):
+            if not isinstance(stmt, ast.Return) or stmt.value is None:
+                continue
+            ret = stmt.value
+            if isinstance(ret, ast.Tuple):
+                for pos, elt in enumerate(ret.elts):
+                    if isinstance(elt, ast.Name) and elt.id in donating:
+                        # "factory returning a donating callable at tuple
+                        # position pos" — callers unpack positionally
+                        out[f"{node.name}@{pos}"] = donating[elt.id]
+            elif isinstance(ret, ast.Name) and ret.id in donating:
+                # bare single return: `x = make_step(...)` binds the
+                # donating callable directly
+                out[node.name] = donating[ret.id]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# per-scope flow analysis
+# ---------------------------------------------------------------------------
+
+class _DonationState(FlowState):
+    def __init__(
+        self,
+        src: SourceFile,
+        donators: Dict[str, Tuple[int, ...]],
+        factories: Dict[str, Tuple[int, ...]],
+        findings: List[Finding],
+        context: str,
+    ):
+        self.src = src
+        self.donators = donators          # name -> donated argnums
+        self.factories = factories        # factory name (+@pos) -> argnums
+        self.findings = findings
+        self.context = context
+        self.dead: Dict[str, str] = {}    # name -> description of the donation
+        self.aliases: Dict[str, Set[str]] = {}  # origin -> alias names
+        self.np_buffers: Set[str] = set()  # names holding device_put-of-numpy
+
+    # -- FlowState plumbing --------------------------------------------------
+    def fork(self) -> "_DonationState":
+        s = _DonationState(self.src, dict(self.donators), self.factories, self.findings, self.context)
+        s.dead = dict(self.dead)
+        s.aliases = {k: set(v) for k, v in self.aliases.items()}
+        s.np_buffers = set(self.np_buffers)
+        return s
+
+    def merge(self, *branches: "_DonationState") -> None:
+        for b in branches:
+            self.dead.update(b.dead)
+            for k, v in b.aliases.items():
+                self.aliases.setdefault(k, set()).update(v)
+            self.np_buffers |= b.np_buffers
+            self.donators.update(b.donators)
+        # union semantics on purpose: dead in ANY path stays dead — a read
+        # that is only safe on one branch is still a bug on the other
+
+    def on_nested_def(self, stmt: ast.stmt) -> None:
+        # nested scope: fresh liveness (its params are new buffers), but the
+        # donating-callable table flows in — the PR 14 wrap() shape is a
+        # nested fn calling an ENCLOSING scope's donating callable
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _scan_scope(
+                self.src, stmt.body, dict(self.donators), self.factories,
+                self.findings, context=stmt.name,
+            )
+
+    # -- statement semantics -------------------------------------------------
+    def visit(self, stmt: ast.stmt) -> None:
+        rebound = assigned_names(stmt)
+
+        # 1. reads of dead names anywhere in this statement (skip nested
+        #    defs/lambdas — execution order unknowable)
+        for node in _walk_no_nested(stmt):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                if node.id in self.dead:
+                    self.findings.append(
+                        Finding(
+                            "use-after-donate",
+                            self.src.rel,
+                            node.lineno,
+                            f"'{node.id}' is read after {self.dead[node.id]}",
+                            context=self.context,
+                        )
+                    )
+                    # one report per donation event; stop cascading
+                    self.dead.pop(node.id, None)
+
+        # 2. donation events + borrowed-buffer checks in calls
+        for node in _walk_no_nested(stmt):
+            if isinstance(node, ast.Call):
+                self._visit_call(node, rebound)
+
+        # 3. rebinding resurrects (BEFORE tracking this statement's own new
+        #    binding, or `y = copy_to(x)` would discard the alias it creates)
+        for name in rebound:
+            self.dead.pop(name, None)
+            # a rebound name no longer aliases anything
+            for origin in self.aliases:
+                self.aliases[origin].discard(name)
+
+        # 4. alias / np-buffer tracking on simple assignments
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 and isinstance(stmt.targets[0], ast.Name):
+            self._track_assign(stmt.targets[0].id, stmt.value)
+
+    def _track_assign(self, target: str, value: ast.expr) -> None:
+        # donating-callable binding: f = compile(g, donate_argnums=...)
+        nums = _donating_callable_argnums(value)
+        if nums:
+            self.donators[target] = nums
+            return
+        # factory binding: f = make_fns(...) for a known factory (single
+        # return position) — tuple unpacking handled in visit via Assign
+        if isinstance(value, ast.Call):
+            fname = call_name(value)
+            # single-return factory (bare `return donating_fn`); tuple
+            # returns only exist under `{fname}@{pos}` keys and are mapped
+            # by the tuple-unpack pre-pass in _scan_scope
+            if fname in self.factories and self.factories[fname]:
+                self.donators[target] = self.factories[fname]
+                return
+            if fname in _ALIAS_HAZARDS and value.args:
+                # note: `y = copy_to(x, d).copy()` never reaches here as a
+                # hazard — the outer .copy() call is what _track_assign
+                # sees, so the alias is naturally broken
+                arg0 = value.args[0]
+                if isinstance(arg0, ast.Name):
+                    self.aliases.setdefault(arg0.id, set()).add(target)
+                    return
+                # device_put of a numpy expression: borrowed host buffer
+                if fname == "device_put" and _is_numpy_expr(arg0, self.np_buffers):
+                    self.np_buffers.add(target)
+                    return
+        # plain alias: y = x
+        if isinstance(value, ast.Name):
+            self.aliases.setdefault(value.id, set()).add(target)
+            return
+        # numpy value: y = np.zeros(...) — becomes interesting if later
+        # device_put and donated
+        if _is_numpy_expr(value, self.np_buffers):
+            self.np_buffers.add(target)
+
+    def _visit_call(self, call: ast.Call, rebound: Set[str]) -> None:
+        # tuple-unpacked factory: a, b = make_fns(...) — map positions
+        # handled here because visit() sees the Assign before rebinding
+        fname = call_name(call)
+        argnums: Optional[Tuple[int, ...]] = None
+        if isinstance(call.func, ast.Name) and call.func.id in self.donators:
+            argnums = self.donators[call.func.id]
+        elif isinstance(call.func, ast.Attribute) and fname in self.donators:
+            # method-style dispatch of a tracked callable (rare) — skip:
+            # attribute identity is not trackable
+            argnums = None
+        if argnums is None:
+            return
+        has_star = any(isinstance(a, ast.Starred) for a in call.args)
+        for pos in argnums:
+            if has_star and pos >= next(
+                (i for i, a in enumerate(call.args) if isinstance(a, ast.Starred)),
+                len(call.args),
+            ):
+                break  # positions past *args are not statically mappable
+            if pos >= len(call.args):
+                continue
+            arg = call.args[pos]
+            if isinstance(arg, ast.Name):
+                name = arg.id
+                if name in self.np_buffers:
+                    self.findings.append(
+                        Finding(
+                            "donation-borrowed-buffer",
+                            self.src.rel,
+                            arg.lineno,
+                            f"'{name}' holds a jax.device_put of a numpy value and is "
+                            f"donated at argnum {pos} of '{call.func.id}' — on CPU "
+                            "device_put can borrow the numpy buffer, so donation "
+                            "frees memory XLA does not own; build it from jnp "
+                            "values instead",
+                            context=self.context,
+                        )
+                    )
+                donated_desc = (
+                    f"being donated at argnum {pos} of '{call.func.id}' "
+                    f"(line {call.lineno})"
+                )
+                if name not in rebound:
+                    self.dead[name] = donated_desc
+                # aliases die with the buffer regardless of rebinding
+                for alias in self.aliases.get(name, ()):  # un-copied aliases
+                    if alias not in rebound:
+                        self.dead[alias] = (
+                            f"'{name}' (which it may alias zero-copy) was "
+                            f"donated at argnum {pos} of '{call.func.id}' "
+                            f"(line {call.lineno}) — break the alias with "
+                            ".copy() before donating"
+                        )
+            elif isinstance(arg, ast.Call) and call_name(arg) == "device_put":
+                if arg.args and _is_numpy_expr(arg.args[0], self.np_buffers):
+                    self.findings.append(
+                        Finding(
+                            "donation-borrowed-buffer",
+                            self.src.rel,
+                            arg.lineno,
+                            f"jax.device_put of a numpy value donated inline at "
+                            f"argnum {pos} of '{call.func.id}' — the donated "
+                            "buffer may be borrowed from numpy",
+                            context=self.context,
+                        )
+                    )
+
+
+def _walk_no_nested(stmt: ast.stmt):
+    """ast.walk skipping nested function/class/lambda bodies."""
+    stack = [stmt]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def _is_numpy_expr(node: ast.expr, np_names: Set[str]) -> bool:
+    """An expression that produces a host numpy buffer: an ``np.*`` /
+    ``numpy.*`` call, or a name already known to hold one."""
+    if isinstance(node, ast.Name):
+        return node.id in np_names
+    if isinstance(node, ast.Call):
+        chain = attr_chain(node.func)
+        return bool(chain) and chain[0] in ("np", "numpy")
+    return False
+
+
+def _scan_scope(
+    src: SourceFile,
+    body: Sequence[ast.stmt],
+    donators: Dict[str, Tuple[int, ...]],
+    factories: Dict[str, Tuple[int, ...]],
+    findings: List[Finding],
+    context: str,
+) -> None:
+    state = _DonationState(src, donators, factories, findings, context)
+    # pre-pass: tuple-unpacked factory results (act_fn, phase = make_fns(...))
+    # must be visible from the first statement of the scope they land in
+    for stmt in body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Tuple)
+            and isinstance(stmt.value, ast.Call)
+        ):
+            fname = call_name(stmt.value)
+            for pos, elt in enumerate(stmt.targets[0].elts):
+                key = f"{fname}@{pos}"
+                if isinstance(elt, ast.Name) and key in factories:
+                    state.donators[elt.id] = factories[key]
+    flow_scan(body, state)

@@ -1,0 +1,247 @@
+"""Rule family 3: PRNG discipline.
+
+JAX keys are not stateful generators: passing the same key to two sampling
+sites yields *identical* randomness, and the framework's bit-exact
+checkpoint/restore contract makes silent stream reuse especially costly
+(two "independent" noise sources move in lockstep forever, and the drift
+only shows up as training pathology).  Two rules:
+
+* ``prng-key-reuse`` — a key-typed name is consumed by a second sink
+  without an intervening ``jax.random.split`` / rebind.  A *sink* is any
+  call the key is passed to, except the known non-consuming plumbing
+  (``fold_in`` derives without consuming; ``key_data`` / ``device_put`` /
+  ``asarray`` / ``replicate`` move or reinterpret).  ``split`` itself
+  consumes its operand — using a key after splitting it IS reuse.  The
+  scan is branch-aware (an if/else where both arms consume the key once is
+  one consumption) and loops are scanned twice, so a key created outside a
+  loop and consumed inside it without rebinding is caught.
+* ``prng-split-discarded`` — the result of ``jax.random.split`` is thrown
+  away (a bare expression statement or an all-``_`` target): the caller
+  paid for a new stream and kept none of it, which almost always means the
+  OLD key keeps getting used.
+
+Key-typed names: bound from ``jax.random.PRNGKey/key/split/fold_in`` or
+``fabric.seed_everything``, or parameters spelled like keys (``key``,
+``k``, ``rng``, ``*_key``).  Only plain names are tracked — attributes and
+containers are out of scope by design (precision over recall).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from sheeprl_tpu.analysis.core import (
+    Finding,
+    FlowState,
+    SourceFile,
+    assigned_names,
+    call_name,
+    flow_scan,
+)
+
+#: callables that CREATE key values (assignment RHS)
+_KEY_MAKERS = ("PRNGKey", "key", "split", "fold_in", "seed_everything", "wrap_key_data", "clone")
+
+#: callables a key can pass through without being consumed
+_NON_CONSUMING = (
+    "fold_in",          # derives a new stream, original stays usable
+    "key_data", "wrap_key_data", "clone",
+    "device_put", "asarray", "array", "replicate", "copy", "copy_to",
+    "block_until_ready", "to_host", "shard_batch",
+    "print", "repr", "str", "format", "append", "isinstance", "len",
+    "ShapeDtypeStruct", "tree_map", "debug_print",
+    # plain-value builtins: params that merely LOOK key-named (copies_per_key)
+    # flow through these without touching any PRNG stream
+    "int", "float", "bool", "max", "min", "abs", "round", "sum", "type",
+)
+
+_KEY_PARAM_NAMES = ("key", "k", "rng", "prng_key", "player_key")
+
+
+def _is_key_param(name: str) -> bool:
+    return name in _KEY_PARAM_NAMES or name.endswith("_key") or name.endswith("_rng")
+
+
+def check(src: SourceFile, ctx) -> List[Finding]:
+    findings: List[Finding] = []
+    _scan(src, src.tree.body, set(), findings, "module")
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            params = {
+                a.arg
+                for a in node.args.posonlyargs + node.args.args + node.args.kwonlyargs
+                if _is_key_param(a.arg)
+            }
+            _scan(src, node.body, params, findings, node.name)
+    return findings
+
+
+def _scan(
+    src: SourceFile,
+    body: Sequence[ast.stmt],
+    initial_keys: Set[str],
+    findings: List[Finding],
+    context: str,
+) -> None:
+    state = _PrngState(src, findings, context)
+    for k in initial_keys:
+        state.keys[k] = None
+    flow_scan(body, state)
+
+
+class _PrngState(FlowState):
+    def __init__(self, src: SourceFile, findings: List[Finding], context: str):
+        self.src = src
+        self.findings = findings
+        self.context = context
+        #: key name -> consumption site description (None = fresh)
+        self.keys: Dict[str, Optional[str]] = {}
+
+    def fork(self) -> "_PrngState":
+        s = _PrngState(self.src, self.findings, self.context)
+        s.keys = dict(self.keys)
+        return s
+
+    def merge(self, *branches: "_PrngState") -> None:
+        for b in branches:
+            for name, consumed in b.keys.items():
+                if name not in self.keys or (consumed is not None and self.keys[name] is None):
+                    self.keys[name] = consumed
+
+    def visit(self, stmt: ast.stmt) -> None:
+        # split-result-discarded: a bare `jax.random.split(...)` statement
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call):
+            if call_name(stmt.value) == "split" and _is_jax_random(stmt.value):
+                self.findings.append(
+                    Finding(
+                        "prng-split-discarded",
+                        self.src.rel,
+                        stmt.lineno,
+                        "result of jax.random.split is discarded — the old key "
+                        "is still live and will be reused",
+                        context=self.context,
+                    )
+                )
+        if isinstance(stmt, ast.Assign):
+            targets = _flat_names(stmt.targets)
+            if (
+                targets
+                and all(t == "_" for t in targets)
+                and isinstance(stmt.value, ast.Call)
+                and call_name(stmt.value) == "split"
+                and _is_jax_random(stmt.value)
+            ):
+                self.findings.append(
+                    Finding(
+                        "prng-split-discarded",
+                        self.src.rel,
+                        stmt.lineno,
+                        "every result of jax.random.split is assigned to '_'",
+                        context=self.context,
+                    )
+                )
+
+        # consumption events, in source order inside the statement
+        rebound = assigned_names(stmt)
+        for call in _calls_no_nested(stmt):
+            cname = call_name(call)
+            if cname in _NON_CONSUMING:
+                continue
+            seen_in_call: Set[str] = set()
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if not (isinstance(arg, ast.Name) and isinstance(arg.ctx, ast.Load)):
+                    continue
+                name = arg.id
+                if name not in self.keys:
+                    continue
+                if name in seen_in_call:
+                    self.findings.append(
+                        Finding(
+                            "prng-key-reuse",
+                            self.src.rel,
+                            call.lineno,
+                            f"key '{name}' passed twice to '{cname}' in one call",
+                            context=self.context,
+                        )
+                    )
+                    continue
+                seen_in_call.add(name)
+                prior = self.keys[name]
+                if prior is not None:
+                    self.findings.append(
+                        Finding(
+                            "prng-key-reuse",
+                            self.src.rel,
+                            call.lineno,
+                            f"key '{name}' consumed again by '{cname}' after {prior} "
+                            "— split it (or thread the returned key) first",
+                            context=self.context,
+                        )
+                    )
+                else:
+                    self.keys[name] = f"being consumed by '{cname}' (line {call.lineno})"
+
+        # creations / rebinding LAST: `key, tk = split(key)` consumes the
+        # old key above, then the new binding resets it here
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Call):
+            if call_name(stmt.value) in _KEY_MAKERS and (
+                _is_jax_random(stmt.value) or call_name(stmt.value) == "seed_everything"
+            ):
+                for t in _flat_names(stmt.targets):
+                    if t != "_":
+                        self.keys[t] = None
+        for name in rebound:
+            if name in self.keys:
+                self.keys[name] = None
+
+
+def _calls_no_nested(stmt: ast.stmt):
+    """Call nodes in this statement, in source order, skipping nested
+    function/lambda bodies (their execution time is unknowable here)."""
+    calls: List[ast.Call] = []
+    stack: List[ast.AST] = [stmt]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Call):
+            calls.append(node)
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef, ast.Lambda)):
+                continue
+            stack.append(child)
+    calls.sort(key=lambda c: (c.lineno, c.col_offset))
+    return calls
+
+
+def _flat_names(targets: Sequence[ast.expr]) -> List[str]:
+    out: List[str] = []
+
+    def collect(t: ast.AST) -> None:
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    for t in targets:
+        collect(t)
+    return out
+
+
+def _is_jax_random(call: ast.Call) -> bool:
+    """``jax.random.X(...)`` / ``random.X(...)`` / ``jrandom.X(...)`` —
+    or a bare name imported from jax.random (``from jax.random import
+    split``).  Bare-name calls are accepted: the cost of a false 'is
+    jax.random' here is only a slightly eager finding on stdlib-random
+    code, which this codebase never mixes with key plumbing."""
+    func = call.func
+    if isinstance(func, ast.Attribute):
+        chain_root = func.value
+        while isinstance(chain_root, ast.Attribute):
+            chain_root = chain_root.value
+        if isinstance(chain_root, ast.Name) and chain_root.id in ("jax", "random", "jrandom", "jr"):
+            return True
+        return False
+    return isinstance(func, ast.Name)

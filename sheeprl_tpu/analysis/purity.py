@@ -1,0 +1,296 @@
+"""Rule family 2: trace purity / recompile hazards.
+
+Functions handed to ``fabric.compile`` / ``compile_once`` / ``jax.jit`` /
+``lax.scan`` / ``window_scan`` / ``jax.grad`` / the fused builders are
+traced once and replayed as a fixed program.  Host-side Python evaluated
+during tracing therefore either freezes (clocks, host RNG), raises
+(``ConcretizationTypeError`` on ``float()``/``if`` over traced values), or
+— worst — silently keys a recompile per concrete value.  Three rules:
+
+* ``trace-impure-time`` — ``time.time()`` / ``datetime.now()`` /
+  ``np.random.*`` / stdlib ``random.*`` calls anywhere in a traced
+  function: the value is baked in at trace time, every later dispatch
+  replays it.
+* ``trace-host-concretize`` — ``float()`` / ``int()`` / ``bool()`` /
+  ``np.<fn>()`` / ``.item()`` applied to an expression that mentions a
+  traced parameter: forces a device sync at best, a tracer leak at worst.
+* ``trace-python-branch`` — ``if`` / ``while`` / ternary whose test
+  mentions a traced parameter (static arguments, declared via
+  ``static_argnums``/``static_argnames`` at the wrapping call, are
+  exempt): data-dependent Python control flow is exactly what
+  ``jnp.where`` / ``lax.cond`` exist for, and the recompile detector only
+  catches it after the signature churns at runtime.
+
+A "traced function" is any local ``def`` whose *name* is passed in the
+function position of a known tracing consumer, or that is decorated with
+``jax.jit`` / ``partial(jax.jit, ...)``.  Static-structure tests —
+``isinstance``/``hasattr``/``len``/``is None``/``.shape``/``.ndim``/
+``.dtype`` comparisons — are recognized as trace-time-legal and skipped.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from sheeprl_tpu.analysis.core import (
+    Finding,
+    SourceFile,
+    attr_chain,
+    call_name,
+    literal_int_tuple,
+    literal_str_tuple,
+)
+
+#: consumer callable name -> positional index of the traced function
+TRACING_CONSUMERS: Dict[str, int] = {
+    "compile": 0,       # fabric.compile(fn, ...)
+    "compile_once": 0,
+    "jit": 0,           # jax.jit / fabric.jit
+    "scan": 0,          # lax.scan(fn, ...)
+    "window_scan": 0,
+    "vmap": 0,
+    "pmap": 0,
+    "grad": 0,
+    "value_and_grad": 0,
+    "checkpoint": 0,    # jax.checkpoint / remat
+    "remat": 0,
+    "wrap": 0,          # HealthSentinel.wrap(phase)
+    "fused_uniform_train": 1,   # fused_*_train(fabric, phase, ...)
+    "fused_sequence_train": 1,
+}
+
+_IMPURE_TIME_CALLS: Tuple[Tuple[str, ...], ...] = (
+    ("time", "time"),
+    ("time", "perf_counter"),
+    ("time", "monotonic"),
+    ("time", "process_time"),
+    ("datetime", "now"),
+    ("datetime", "utcnow"),
+    ("datetime", "datetime", "now"),
+)
+
+_CONCRETIZERS = ("float", "int", "bool", "complex")
+
+
+def check(src: SourceFile, ctx) -> List[Finding]:
+    traced = _find_traced_functions(src.tree)
+    findings: List[Finding] = []
+    for fn, static_names in traced.items():
+        params = _param_names(fn) - static_names
+        _check_traced_fn(src, fn, params, findings)
+    return findings
+
+
+def _param_names(fn: ast.AST) -> Set[str]:
+    args = fn.args
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    return set(names)
+
+
+def _find_traced_functions(tree: ast.Module) -> Dict[ast.AST, Set[str]]:
+    """Map of FunctionDef -> static argument names (exempt from the traced
+    set), for every def whose name reaches a tracing consumer."""
+    # name -> defs with that name (any scope; collisions are conservative)
+    defs: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+
+    traced: Dict[ast.AST, Set[str]] = {}
+
+    def mark(name: str, static_names: Set[str], static_nums: Tuple[int, ...]) -> None:
+        for fn in defs.get(name, ()):
+            statics = set(static_names)
+            params = [a.arg for a in fn.args.posonlyargs + fn.args.args]
+            for i in static_nums:
+                if i < len(params):
+                    statics.add(params[i])
+            traced.setdefault(fn, set()).update(statics)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            cname = call_name(node)
+            if cname not in TRACING_CONSUMERS:
+                continue
+            idx = TRACING_CONSUMERS[cname]
+            if idx >= len(node.args):
+                continue
+            fn_arg = node.args[idx]
+            if not isinstance(fn_arg, ast.Name):
+                continue
+            static_names: Set[str] = set()
+            static_nums: Tuple[int, ...] = ()
+            for kw in node.keywords:
+                if kw.arg == "static_argnames":
+                    static_names = set(literal_str_tuple(kw.value))
+                elif kw.arg == "static_argnums":
+                    static_nums = literal_int_tuple(kw.value) or ()
+            mark(fn_arg.id, static_names, static_nums)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                chain = attr_chain(dec if not isinstance(dec, ast.Call) else dec.func)
+                if chain and chain[-1] in ("jit",):
+                    traced.setdefault(node, set())
+                elif (
+                    isinstance(dec, ast.Call)
+                    and call_name(dec) == "partial"
+                    and dec.args
+                    and (attr_chain(dec.args[0]) or [""])[-1] == "jit"
+                ):
+                    statics = set()
+                    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+                    for kw in dec.keywords:
+                        if kw.arg == "static_argnames":
+                            statics |= set(literal_str_tuple(kw.value))
+                        elif kw.arg == "static_argnums":
+                            for i in literal_int_tuple(kw.value) or ():
+                                if i < len(params):
+                                    statics.add(params[i])
+                    traced.setdefault(node, set()).update(statics)
+    return traced
+
+
+def _check_traced_fn(
+    src: SourceFile, fn: ast.AST, params: Set[str], findings: List[Finding]
+) -> None:
+    ctx_name = getattr(fn, "name", "<traced>")
+    for node in ast.walk(fn):
+        # impure host clocks / host RNG — flagged regardless of arguments
+        if isinstance(node, ast.Call):
+            chain = attr_chain(node.func)
+            if chain:
+                tchain = tuple(chain)
+                if tchain in _IMPURE_TIME_CALLS or (
+                    len(chain) >= 2 and chain[0] in ("np", "numpy") and chain[1] == "random"
+                ) or (len(chain) == 2 and chain[0] == "random" and chain[1] in (
+                    "random", "randint", "uniform", "normalvariate", "choice", "shuffle", "gauss"
+                )):
+                    findings.append(
+                        Finding(
+                            "trace-impure-time",
+                            src.rel,
+                            node.lineno,
+                            f"'{'.'.join(chain)}()' inside traced function "
+                            f"'{ctx_name}' — evaluated once at trace time, "
+                            "frozen into every later dispatch",
+                            context=ctx_name,
+                        )
+                    )
+                    continue
+            # host concretization of traced values
+            cname = call_name(node)
+            if cname in _CONCRETIZERS and node.args and _mentions(node.args[0], params):
+                findings.append(
+                    Finding(
+                        "trace-host-concretize",
+                        src.rel,
+                        node.lineno,
+                        f"'{cname}()' over a traced value inside '{ctx_name}' — "
+                        "raises ConcretizationTypeError under jit (or silently "
+                        "freezes the value); keep the computation in jnp",
+                        context=ctx_name,
+                    )
+                )
+                continue
+            if (
+                chain
+                and chain[0] in ("np", "numpy")
+                and len(chain) >= 2
+                and chain[1] != "random"
+                and any(_mentions(a, params) for a in node.args)
+            ):
+                findings.append(
+                    Finding(
+                        "trace-host-concretize",
+                        src.rel,
+                        node.lineno,
+                        f"'{'.'.join(chain)}()' applied to a traced value inside "
+                        f"'{ctx_name}' — numpy pulls the value to host at trace "
+                        "time; use the jnp equivalent",
+                        context=ctx_name,
+                    )
+                )
+                continue
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item"
+                and _mentions(node.func.value, params)
+            ):
+                findings.append(
+                    Finding(
+                        "trace-host-concretize",
+                        src.rel,
+                        node.lineno,
+                        f"'.item()' on a traced value inside '{ctx_name}'",
+                        context=ctx_name,
+                    )
+                )
+        # Python control flow on traced values
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+            test = node.test
+            if _mentions_dynamic(test, params):
+                kind = {
+                    ast.If: "if",
+                    ast.While: "while",
+                    ast.IfExp: "ternary",
+                    ast.Assert: "assert",
+                }[type(node)]
+                findings.append(
+                    Finding(
+                        "trace-python-branch",
+                        src.rel,
+                        node.lineno,
+                        f"Python '{kind}' on a traced value inside '{ctx_name}' — "
+                        "the branch is resolved ONCE at trace time (or raises); "
+                        "use jnp.where / lax.cond / lax.while_loop, or declare "
+                        "the argument static",
+                        context=ctx_name,
+                    )
+                )
+
+
+def _mentions(node: ast.AST, params: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load) and sub.id in params:
+            return True
+    return False
+
+
+#: attributes whose access yields STATIC (trace-time) information
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "keys")
+
+
+def _mentions_dynamic(test: ast.AST, params: Set[str]) -> bool:
+    """Does ``test`` read a traced param in a way that needs its VALUE —
+    i.e. not through a static-structure probe (isinstance/hasattr/len,
+    ``is None`` comparisons, .shape/.ndim/.dtype/.size access)?"""
+    dynamic = False
+
+    def scan(node: ast.AST) -> None:
+        nonlocal dynamic
+        if dynamic:
+            return
+        if isinstance(node, ast.Call) and call_name(node) in (
+            "isinstance", "hasattr", "len", "getattr", "callable",
+        ):
+            return  # static probes — ignore whole subtree
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return
+        if isinstance(node, ast.Compare) and any(
+            isinstance(c, ast.Constant) and c.value is None for c in node.comparators
+        ):
+            # `x is None` / `x == None` — structural, legal at trace time
+            ops_ok = all(isinstance(o, (ast.Is, ast.IsNot, ast.Eq, ast.NotEq)) for o in node.ops)
+            if ops_ok:
+                return
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load) and node.id in params:
+            dynamic = True
+            return
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    scan(test)
+    return dynamic

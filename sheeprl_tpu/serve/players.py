@@ -179,7 +179,9 @@ def build_ppo_player(fabric: Any, cfg: Any, state: Dict[str, Any], obs_space: An
         a_sample, _, _ = sample_actions(
             out, actions_dim, is_continuous, key, greedy=False, dist_type=dist_type
         )
-        a_greedy, _, _ = sample_actions(
+        # the greedy arm takes mode(), never drawing from `key` — the dual-arm
+        # per-row select is ONE real consumer of the stream
+        a_greedy, _, _ = sample_actions(  # graftlint: disable=prng-key-reuse
             out, actions_dim, is_continuous, key, greedy=True, dist_type=dist_type
         )
         return carry, jnp.where(greedy[:, None], a_greedy, a_sample)
@@ -291,8 +293,10 @@ def build_dreamer_v3_player(fabric: Any, cfg: Any, state: Dict[str, Any], obs_sp
         out = actor.apply(p["actor"], latent)
         a = jnp.where(
             greedy[:, None],
+            # greedy arm takes mode() and never draws from k_act: the dual-arm
+            # select has ONE real consumer of the stream
             actor.sample(out, k_act, greedy=True),
-            actor.sample(out, k_act, greedy=False),
+            actor.sample(out, k_act, greedy=False),  # graftlint: disable=prng-key-reuse
         )
         return (h, z, a), a
 

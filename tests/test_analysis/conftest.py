@@ -1,0 +1,52 @@
+"""Shared helpers for the graftlint test suite.
+
+``lint_snippet`` runs the per-file rules over an inline code snippet and
+returns findings; ``line_of`` locates an expected finding's line by a
+source marker so tests never hard-code brittle line numbers.
+"""
+
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.analysis import RepoContext
+from sheeprl_tpu.analysis.core import SourceFile, repo_root
+from sheeprl_tpu.analysis import donation, prng, purity, registry
+
+
+@pytest.fixture(scope="session")
+def repo_ctx():
+    """The real RepoContext (config tree + fault registry), built once."""
+    return RepoContext.build(repo_root())
+
+
+def lint_snippet(code: str, ctx=None, rules=("donation", "purity", "prng", "registry")):
+    src = SourceFile(Path("snippet.py"), "snippet.py", textwrap.dedent(code))
+    findings = []
+    if "donation" in rules:
+        findings += donation.check(src, ctx)
+    if "purity" in rules:
+        findings += purity.check(src, ctx)
+    if "prng" in rules:
+        findings += prng.check(src, ctx)
+    if "registry" in rules and ctx is not None:
+        findings += registry.check_file(src, ctx)
+    # dedupe like the driver (the loop two-pass can repeat findings)
+    uniq = {}
+    for f in findings:
+        uniq.setdefault((f.rule, f.path, f.line, f.message), f)
+    return sorted(uniq.values(), key=lambda f: (f.line, f.rule))
+
+
+def line_of(code: str, marker: str) -> int:
+    """1-based line of the first line containing ``marker`` (post-dedent —
+    dedent only strips leading whitespace, line numbers are unchanged)."""
+    for i, line in enumerate(textwrap.dedent(code).splitlines(), 1):
+        if marker in line:
+            return i
+    raise AssertionError(f"marker {marker!r} not in snippet")
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]

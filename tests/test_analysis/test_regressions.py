@@ -1,0 +1,171 @@
+"""Historical-bug regression fixtures: the two worst shipped bugs, as the
+analyzer must see them.  These snippets are structural reductions of the
+actual defective code (PR 7's same-platform ``copy_to`` zero-copy alias;
+PR 14's ``HealthSentinel`` donation-aliasing and ``device_put``-borrowed
+-buffer pair) — if a rule refactor stops flagging either, CI stops the
+regression HERE rather than in a chaos drill three PRs later.
+"""
+
+from tests.test_analysis.conftest import lint_snippet, line_of, rules_of
+
+
+class TestPR7CopyToAlias:
+    """PR 7: ``fabric.copy_to(params, host)`` on a same-platform pair was a
+    zero-copy alias of shard 0, so the first donated train dispatch deleted
+    the player's param copy ("buffer has been deleted or donated")."""
+
+    FIXTURE = """
+    def train_loop(fabric, train, params, host, obs):
+        step = fabric.compile(train, donate_argnums=(0,))
+        player_params = fabric.copy_to(params, host)   # zero-copy alias
+        for _ in range(10):
+            params = step(params)                      # donates the aliased buffer
+            act(player_params, obs)                    # READ of the dead alias
+        return params
+    """
+
+    def test_flagged_by_use_after_donate(self):
+        findings = lint_snippet(self.FIXTURE)
+        assert rules_of(findings) == ["use-after-donate"]
+        f = findings[0]
+        assert f.line == line_of(self.FIXTURE, "# READ")
+        assert "player_params" in f.message
+        assert "alias" in f.message
+
+    def test_the_pr7_fix_shape_is_clean(self):
+        # the actual fix: copy_to alias-breaks internally; the analyzer's
+        # spelling of that at a call site is an explicit .copy()
+        code = """
+        def train_loop(fabric, train, params, host, obs):
+            step = fabric.compile(train, donate_argnums=(0,))
+            player_params = fabric.copy_to(params, host).copy()
+            for _ in range(10):
+                params = step(params)
+                act(player_params, obs)
+            return params
+        """
+        assert lint_snippet(code) == []
+
+
+class TestPR14DonationAliasing:
+    """PR 14: ``HealthSentinel.wrap`` traced the JITTED (donating) callable
+    inside the guard program and re-read the original params for the
+    old-vs-new select — the inner donate_argnums survives inlining as an
+    aliasing hint, so XLA may clobber the donated input mid-read."""
+
+    FIXTURE = """
+    import jax
+    import jax.numpy as jnp
+
+    def wrap(compile_once, phase_raw):
+        phase = compile_once(phase_raw, donate_argnums=(0, 1))
+
+        def guarded(h, p, o, batch):
+            new_p, new_o, aux = phase(p, o, batch)
+            keep = jax.tree.map(lambda a, b: jnp.where(h, a, b), new_p, p)  # READ
+            return keep, new_o, aux
+
+        return guarded
+    """
+
+    def test_flagged_by_use_after_donate(self):
+        findings = lint_snippet(self.FIXTURE)
+        assert rules_of(findings) == ["use-after-donate"]
+        f = findings[0]
+        assert f.line == line_of(self.FIXTURE, "# READ")
+        assert "'p'" in f.message
+
+    def test_the_pr14_fix_shape_is_clean(self):
+        # the fix: trace the RAW (undonated) phase — AOTFunction.fn
+        code = """
+        import jax
+        import jax.numpy as jnp
+
+        def wrap(compile_once, phase_raw):
+            def guarded(h, p, o, batch):
+                new_p, new_o, aux = phase_raw(p, o, batch)
+                keep = jax.tree.map(lambda a, b: jnp.where(h, a, b), new_p, p)
+                return keep, new_o, aux
+
+            return guarded
+        """
+        assert lint_snippet(code) == []
+
+
+class TestPR14BorrowedBuffer:
+    """PR 14 sibling facet: the zero HealthState was built by
+    ``jax.device_put`` of numpy scalars; CPU device_put can zero-copy
+    BORROW the numpy buffer, so donating it hands XLA memory it does not
+    own (intermittent heap corruption, reproduced 5x in the kill -9
+    chaos-resume drill)."""
+
+    FIXTURE = """
+    import jax
+    import numpy as np
+
+    def init_and_train(compile_once, phase, p, o, batch):
+        h_dev = jax.device_put(np.zeros((4,), np.float32))   # borrowed buffer
+        guarded = compile_once(phase, donate_argnums=(0, 1, 2))
+        p, o, h_dev = guarded(p, o, h_dev, batch)  # DONATE
+        return p, o, h_dev
+    """
+
+    def test_flagged_by_donation_rule(self):
+        findings = lint_snippet(self.FIXTURE)
+        assert rules_of(findings) == ["donation-borrowed-buffer"]
+        f = findings[0]
+        assert f.line == line_of(self.FIXTURE, "# DONATE")
+        assert "h_dev" in f.message
+
+    def test_the_pr14_fix_shape_is_clean(self):
+        # the fix: build the state from jnp (XLA-owned) values
+        code = """
+        import jax.numpy as jnp
+
+        def init_and_train(compile_once, phase, p, o, batch):
+            h_dev = jnp.zeros((4,), jnp.float32)
+            guarded = compile_once(phase, donate_argnums=(0, 1, 2))
+            p, o, h_dev = guarded(p, o, h_dev, batch)
+            return p, o, h_dev
+        """
+        assert lint_snippet(code) == []
+
+
+class TestRealLoopShapesStayClean:
+    """The canonical healthy loop shapes from the live codebase must never
+    regress into findings — zero-unsuppressed is a hard repo invariant."""
+
+    def test_sac_style_loop(self):
+        code = """
+        import jax
+
+        def sac_loop(fabric, phase_raw, params, opt_state, key, batches):
+            train_phase = fabric.compile(
+                phase_raw, donate_argnums=(0, 1), max_recompiles=1
+            )
+            for update in range(100):
+                key, tk = jax.random.split(key)
+                params, opt_state, losses = train_phase(params, opt_state, batches, tk)
+            return params, opt_state, losses
+        """
+        assert lint_snippet(code) == []
+
+    def test_sebulba_learner_style_loop(self):
+        code = """
+        import jax
+        import jax.numpy as jnp
+
+        def learner(learner_fab, phase, params, opt_state, key, queue, broadcast):
+            learner_phase = learner_fab.compile(
+                phase, donate_argnums=(0, 1), max_recompiles=1
+            )
+            for update in range(100):
+                segs = queue.pop_all()
+                key, tk = jax.random.split(key)
+                params, opt_state, losses = learner_phase(
+                    params, opt_state, segs, tk
+                )
+                broadcast.publish(params, version=update)
+            return params, opt_state
+        """
+        assert lint_snippet(code) == []

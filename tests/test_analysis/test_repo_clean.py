@@ -1,0 +1,64 @@
+"""The tier-1 gate: graftlint over the live ``sheeprl_tpu/`` package must
+report ZERO unsuppressed findings against the checked-in baseline, with no
+stale baseline entries, inside the CI wall budget.
+
+This is the acceptance criterion of the analyzer PR made permanent: every
+later PR that introduces a donation/purity/PRNG/registry violation — or
+fixes a baselined one without deleting its ledger entry — goes red here.
+"""
+
+import pytest
+
+from sheeprl_tpu.analysis import Baseline, DEFAULT_BASELINE, METRIC_FAMILIES, RULE_IDS, run_analysis
+from sheeprl_tpu.analysis.core import repo_root
+
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return run_analysis(baseline=Baseline.load(DEFAULT_BASELINE))
+
+
+def test_zero_unsuppressed_findings(repo_report):
+    assert repo_report.findings == [], "\n" + "\n".join(
+        f.render() for f in repo_report.findings
+    )
+
+
+def test_no_stale_baseline_entries(repo_report):
+    assert repo_report.stale_baseline == [], (
+        "baseline entries matching nothing (delete them — their findings "
+        f"are fixed): {repo_report.stale_baseline}"
+    )
+
+
+def test_every_baselined_finding_has_a_reasoned_entry(repo_report):
+    # the ledger carries real reasons (Baseline.load validates non-empty);
+    # spot-check the shape the analyzer PR established
+    b = Baseline.load(DEFAULT_BASELINE)
+    for entry in b.entries:
+        assert len(entry["reason"]) > 40, entry  # a sentence, not a shrug
+
+def test_analyzer_covers_the_whole_package(repo_report):
+    # ~170 files today; a collapse in coverage (walker bug, parse regression)
+    # must not masquerade as cleanliness
+    assert repo_report.files_analyzed > 150
+
+
+def test_wall_budget(repo_report):
+    # CI gives the lint stage 60 s; the in-process run must stay far inside
+    assert repo_report.wall_s < 60, f"graftlint took {repo_report.wall_s:.1f}s"
+
+
+def test_rule_catalogue_is_documented():
+    doc = (repo_root() / "docs" / "static_analysis.md").read_text()
+    for rule in RULE_IDS:
+        assert f"`{rule}`" in doc, f"rule {rule} missing from docs/static_analysis.md"
+
+
+def test_metric_families_are_documented():
+    doc = (repo_root() / "docs" / "static_analysis.md").read_text()
+    for family in METRIC_FAMILIES:
+        assert f"`{family}/`" in doc, (
+            f"metric family {family}/ missing from docs/static_analysis.md — "
+            "the analyzer registry and the docs table must stay in sync"
+        )

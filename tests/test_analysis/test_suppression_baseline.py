@@ -1,0 +1,198 @@
+"""Suppression-comment and baseline mechanics.
+
+The zero-unsuppressed invariant only means something if the two accept
+mechanisms are themselves well-behaved: suppressions must be precise (the
+named rule, that line, nothing else), typos must not silently disarm, and
+baseline entries must carry reasons and go stale loudly.
+"""
+
+import json
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from sheeprl_tpu.analysis import Baseline, BaselineError, run_analysis
+from sheeprl_tpu.analysis.baseline import DEFAULT_BASELINE
+from sheeprl_tpu.analysis.core import Finding, SourceFile
+
+VIOLATION = """
+import jax
+
+
+def run(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,)){trailer}
+    return a, b
+"""
+
+
+def _write(tmp_path: Path, code: str) -> Path:
+    p = tmp_path / "mod.py"
+    p.write_text(textwrap.dedent(code))
+    return p
+
+
+def _run(tmp_path, code, **kwargs):
+    return run_analysis([_write(tmp_path, code)], root=tmp_path, **kwargs)
+
+
+class TestSuppressionComments:
+    def test_unsuppressed_violation_is_reported(self, tmp_path):
+        report = _run(tmp_path, VIOLATION.format(trailer=""))
+        assert [f.rule for f in report.findings] == ["prng-key-reuse"]
+        assert report.suppressed == []
+
+    def test_same_line_suppression(self, tmp_path):
+        report = _run(
+            tmp_path,
+            VIOLATION.format(trailer="  # graftlint: disable=prng-key-reuse"),
+        )
+        assert report.findings == []
+        assert [f.rule for f in report.suppressed] == ["prng-key-reuse"]
+
+    def test_preceding_comment_line_suppression(self, tmp_path):
+        code = """
+        import jax
+
+
+        def run(key):
+            a = jax.random.normal(key, (4,))
+            # deliberate: arms are mutually exclusive downstream
+            # graftlint: disable=prng-key-reuse
+            b = jax.random.uniform(key, (4,))
+            return a, b
+        """
+        report = _run(tmp_path, code)
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_wrong_rule_does_not_suppress(self, tmp_path):
+        report = _run(
+            tmp_path,
+            VIOLATION.format(trailer="  # graftlint: disable=use-after-donate"),
+        )
+        assert [f.rule for f in report.findings] == ["prng-key-reuse"]
+
+    def test_unknown_rule_does_not_suppress(self, tmp_path):
+        report = _run(
+            tmp_path,
+            VIOLATION.format(trailer="  # graftlint: disable=prng-key-resue"),
+        )
+        assert [f.rule for f in report.findings] == ["prng-key-reuse"]
+        # ...and the typo is surfaced, not silently ignored
+        assert any("prng-key-resue" in n for n in report.notes)
+
+    def test_file_wide_suppression(self, tmp_path):
+        code = "# graftlint: disable-file=prng-key-reuse\n" + textwrap.dedent(
+            VIOLATION.format(trailer="")
+        )
+        p = tmp_path / "mod.py"
+        p.write_text(code)
+        report = run_analysis([p], root=tmp_path)
+        assert report.findings == []
+        assert len(report.suppressed) == 1
+
+    def test_string_literal_cannot_fake_a_suppression(self, tmp_path):
+        # comments come from tokenize: a string containing the magic text
+        # must not suppress anything
+        code = VIOLATION.format(trailer="") + (
+            '\nMAGIC = "graftlint: disable-file=prng-key-reuse"\n'
+        )
+        report = _run(tmp_path, code)
+        assert [f.rule for f in report.findings] == ["prng-key-reuse"]
+
+
+class TestBaseline:
+    def _finding(self):
+        return Finding("prng-key-reuse", "mod.py", 7, "key 'key' consumed again by 'uniform'")
+
+    def test_match_by_rule_file_substring(self, tmp_path):
+        b = Baseline(
+            [{"rule": "prng-key-reuse", "file": "mod.py", "match": "consumed again", "reason": "r"}]
+        )
+        report = _run(tmp_path, VIOLATION.format(trailer=""), baseline=b)
+        assert report.findings == []
+        assert len(report.baselined) == 1
+        assert b.stale_entries() == []
+
+    def test_wrong_file_does_not_match(self, tmp_path):
+        b = Baseline(
+            [{"rule": "prng-key-reuse", "file": "other.py", "match": "consumed again", "reason": "r"}]
+        )
+        report = _run(tmp_path, VIOLATION.format(trailer=""), baseline=b)
+        assert [f.rule for f in report.findings] == ["prng-key-reuse"]
+        assert report.stale_baseline == b.entries
+
+    def test_stale_entry_is_surfaced(self, tmp_path):
+        b = Baseline(
+            [{"rule": "use-after-donate", "file": "mod.py", "match": "nothing", "reason": "r"}]
+        )
+        report = _run(tmp_path, VIOLATION.format(trailer=""), baseline=b)
+        assert len(report.stale_baseline) == 1
+
+    def test_entry_without_reason_is_rejected(self):
+        with pytest.raises(BaselineError, match="reason"):
+            Baseline([{"rule": "prng-key-reuse", "match": "x"}])
+
+    def test_entry_with_unknown_rule_is_rejected(self):
+        with pytest.raises(BaselineError, match="unknown rule"):
+            Baseline([{"rule": "not-a-rule", "reason": "r"}])
+
+    def test_write_and_reload_roundtrip(self, tmp_path):
+        findings = [self._finding()]
+        path = tmp_path / "baseline.json"
+        Baseline.write(findings, path, "bootstrap")
+        b = Baseline.load(path)
+        assert b.matches(findings[0])
+        data = json.loads(path.read_text())
+        assert data["entries"][0]["reason"] == "bootstrap"
+
+    def test_checked_in_baseline_is_valid(self):
+        b = Baseline.load(DEFAULT_BASELINE)
+        for entry in b.entries:
+            assert entry["reason"].strip()
+
+    def test_select_does_not_stale_other_rules_entries(self, tmp_path):
+        # `--select x --strict` must not report baseline entries for OTHER
+        # rules as stale: matching runs before the selection filter
+        b = Baseline(
+            [{"rule": "prng-key-reuse", "file": "mod.py", "match": "consumed again", "reason": "r"}]
+        )
+        report = _run(
+            tmp_path,
+            VIOLATION.format(trailer=""),
+            baseline=b,
+            select=["use-after-donate"],
+        )
+        assert report.findings == []  # prng finding deselected
+        assert report.stale_baseline == []  # ...but its entry still matched
+
+
+class TestCLI:
+    def test_exit_codes(self, tmp_path, capsys):
+        from sheeprl_tpu.analysis.__main__ import main
+
+        bad = _write(tmp_path, VIOLATION.format(trailer=""))
+        assert main([str(bad), "--no-baseline"]) == 1
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good), "--no-baseline"]) == 0
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        assert "use-after-donate" in out
+
+    def test_json_format(self, tmp_path, capsys):
+        from sheeprl_tpu.analysis.__main__ import main
+
+        bad = _write(tmp_path, VIOLATION.format(trailer=""))
+        assert main([str(bad), "--no-baseline", "--format", "json"]) == 1
+        payload = json.loads(capsys.readouterr().out)
+        assert payload["unsuppressed"][0]["rule"] == "prng-key-reuse"
+
+    def test_select_unknown_rule_is_usage_error(self, tmp_path, capsys):
+        from sheeprl_tpu.analysis.__main__ import main
+
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good), "--select", "nope"]) == 2
