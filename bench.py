@@ -948,6 +948,231 @@ def bench_sebulba() -> dict:
     }
 
 
+def bench_pipeline() -> dict:
+    """MPMD pipeline-parallel world-model update bench (``--mode pipeline``,
+    ISSUE 16).
+
+    Two measured arms of the SAME DreamerV3 train phase
+    (``_build_dv3_train_phase`` — the benchmarked program IS the training
+    program):
+
+    * **GSPMD baseline** — data-parallel mesh over every device, the
+      ``pipeline`` group off (the monolithic pre-pipeline program);
+    * **pipelined** — a ``pipeline`` mesh axis + ``pipeline=2stage``: the
+      world-model update runs as the in-trace 1F1B microbatch schedule
+      (parallel/pipeline.py, docs/pipeline.md) inside the same ONE jitted
+      dispatch.
+
+    Reports updates/s for both arms, the schedule's bubble fraction, and a
+    per-stage phase breakdown — ``pipeline.stage.<name>.fwd/.bwd`` spans
+    timed over standalone ``compile_stage_pair`` programs built from the
+    same stage functions the fused phase pipelines (``make_wm_stages``).
+    GATES the ISSUE 16 acceptance: ``steady_compiles == 0`` across both
+    armed steady windows, ``cache_size() == 1`` for both phase
+    executables, and the span fractions summing to ~1.0.  The speedup
+    ratio is reported but NOT gated: fake CPU devices share host cores, so
+    the A/B only orders truthfully on real chips (BENCH_TPU.md).
+    """
+    # CPU hosts need fake devices for a real pipeline axis — must land in
+    # XLA_FLAGS before the backend initializes (no-op if already forced)
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.parallel.fabric import build_fabric
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+    from sheeprl_tpu.utils.utils import device_sync
+
+    n_devices = len(jax.devices())
+    size = os.environ.get("BENCH_PIPE_SIZE", "XS")
+    L = int(os.environ.get("BENCH_PIPE_L", 8))
+    B = int(os.environ.get("BENCH_PIPE_B", 8))
+    U = int(os.environ.get("BENCH_PIPE_U", 1))
+    iters = int(os.environ.get("BENCH_PIPE_ITERS", 6))
+    stage_iters = int(os.environ.get("BENCH_PIPE_STAGE_ITERS", 5))
+    # pipelined-arm mesh: 4-deep pipeline axis when the device count allows,
+    # 2-deep otherwise (B must stay divisible by BOTH data axes below)
+    if os.environ.get("BENCH_PIPE_MESH"):
+        pipe_mesh = os.environ["BENCH_PIPE_MESH"]
+    elif n_devices % 4 == 0 and n_devices >= 8:
+        pipe_mesh = f"{{data: {n_devices // 4}, pipeline: 4}}"
+    else:
+        pipe_mesh = f"{{data: {max(1, n_devices // 2)}, pipeline: 2}}"
+
+    common = [
+        "exp=dreamer_v3",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        f"algo=dreamer_v3_{size}",
+        "algo.cnn_keys.encoder=[rgb]",
+        "algo.mlp_keys.encoder=[]",
+        f"algo.per_rank_batch_size={B}",
+        f"algo.per_rank_sequence_length={L}",
+        "algo.max_recompiles=1",
+        "fabric.accelerator=auto",
+        f"fabric.devices={n_devices}",
+        "print_config=False",
+    ]
+
+    rng = np.random.default_rng(0)
+    block_np = {
+        "rgb": rng.integers(0, 255, (U, L, B, 64, 64, 3)).astype(np.uint8),
+        "actions": rng.integers(0, 2, (U, L, B, 4)).astype(np.float32),
+        "rewards": rng.normal(size=(U, L, B)).astype(np.float32),
+        "terminated": np.zeros((U, L, B), np.float32),
+        "is_first": np.zeros((U, L, B), np.float32),
+    }
+
+    def _arm(extra):
+        """One measured arm: build the phase, warm it, then time `iters`
+        steady windows under the armed H2D transfer guard."""
+        cfg = compose(common + extra)
+        fabric = build_fabric(cfg)
+        train_phase, params, opt_state = _build_dv3_train_phase(fabric, cfg)
+        block = fabric.shard_batch(
+            {k: jnp.asarray(v) for k, v in block_np.items()}, axis=2
+        )
+        key = jax.random.PRNGKey(0)
+        t0 = time.perf_counter()
+        params, opt_state, metrics = train_phase(params, opt_state, block, key, jnp.int32(0))
+        device_sync((params, metrics))
+        first_call_s = time.perf_counter() - t0
+        # counters pre-staged OUTSIDE the guard (eager host ints are H2D)
+        steps_dev = [jax.device_put(np.int32(i + 1)) for i in range(iters)]
+        n0, _ = COMPILE_MONITOR.totals()
+        t0 = time.perf_counter()
+        with jax.transfer_guard_host_to_device("disallow"):
+            for i in range(iters):
+                params, opt_state, metrics = train_phase(
+                    params, opt_state, block, key, steps_dev[i]
+                )
+        device_sync((params, metrics))
+        wall = time.perf_counter() - t0
+        n1, _ = COMPILE_MONITOR.totals()
+        return {
+            "updates_per_s": U * iters / wall,
+            "first_call_s": first_call_s,
+            "steady_compiles": n1 - n0,
+            "cache_size": train_phase.cache_size(),
+            "mesh_shape": {k: int(v) for k, v in fabric.mesh.shape.items()},
+        }, cfg, fabric
+
+    base, _, _ = _arm([f"fabric.mesh_shape={{data: {n_devices}}}"])
+    pipe_arm, pipe_cfg, pipe_fabric = _arm(
+        [f"fabric.mesh_shape={pipe_mesh}", "pipeline=2stage"]
+    )
+
+    # ---- per-stage phase breakdown (standalone stage programs) ------------
+    from gymnasium import spaces
+
+    from sheeprl_tpu.algos.dreamer_v3.agent import build_agent
+    from sheeprl_tpu.algos.dreamer_v3.dreamer_v3 import make_wm_stages
+    from sheeprl_tpu.parallel.pipeline import (
+        compile_stage_pair, resolve_pipeline, split_microbatches,
+    )
+    from sheeprl_tpu.telemetry.spans import SPANS
+    from sheeprl_tpu.utils.distribution import OneHotCategorical
+
+    spec = resolve_pipeline(pipe_cfg)
+    obs_space = spaces.Dict({"rgb": spaces.Box(0, 255, (64, 64, 3), np.uint8)})
+    world_model, _, _, agent_params = build_agent(
+        pipe_fabric, (4,), False, pipe_cfg, obs_space
+    )
+    wm_params = agent_params["world_model"]
+    _, stage_fns, stage_names = make_wm_stages(pipe_cfg, world_model, ("rgb",), ())
+
+    data = {k: jnp.asarray(v[0]) for k, v in block_np.items()}  # one (L, B, *) update
+    noise = jax.vmap(
+        lambda kk: OneHotCategorical.sample_noise(
+            kk, (B, world_model.stochastic_size, world_model.discrete_size)
+        )
+    )(jax.random.split(jax.random.PRNGKey(1), L))
+    consts = split_microbatches({"data": data, "noise": noise}, spec.microbatches, axis=1)
+    const_mb = jax.tree.map(lambda a: a[0], consts)  # one microbatch slice
+
+    programs = []
+    carry = None
+    for raw, nm in zip(stage_fns, stage_names):
+        # the params ride as the differentiable operand so the stage
+        # backward measures the REAL 1F1B cost (param grads); the carry and
+        # microbatch const are baked in as program constants
+        def _stage(p, x, _raw=raw, _carry=carry):
+            return _raw(x, _carry, const_mb)
+
+        fwd_c, bwd_c = compile_stage_pair(pipe_fabric, _stage, name=f"pipeline.stage.{nm}")
+        out = fwd_c(wm_params, wm_params)  # warm fwd; also the next stage's carry
+        px = jax.tree.map(lambda a: a.copy(), wm_params)
+        dy = jax.tree.map(jnp.ones_like, out)
+        bwd_c(wm_params, px, dy)  # warm bwd (compiles land outside the spans)
+        programs.append((nm, fwd_c, bwd_c))
+        carry = out
+
+    SPANS.roll_window()
+    for _ in range(stage_iters):
+        for nm, fwd_c, bwd_c in programs:
+            with SPANS.span(f"pipeline.stage.{nm}.fwd"):
+                out = fwd_c(wm_params, wm_params)
+                device_sync(out)
+            # canonical rebinding: bwd DONATES the activation copy and the
+            # cotangent — both are freshly created every iteration
+            px = jax.tree.map(lambda a: a.copy(), wm_params)
+            dy = jax.tree.map(jnp.ones_like, out)
+            with SPANS.span(f"pipeline.stage.{nm}.bwd"):
+                grads = bwd_c(wm_params, px, dy)
+                device_sync(grads)
+    breakdown = SPANS.breakdown()
+
+    steady_compiles = base["steady_compiles"] + pipe_arm["steady_compiles"]
+    cache_ok = base["cache_size"] == 1 and pipe_arm["cache_size"] == 1
+    frac_sum = _phase_frac_sum(breakdown)
+    frac_ok = abs(frac_sum - 1.0) < 0.02
+    dev = jax.devices()[0]
+    return {
+        "metric": (
+            f"dreamer_v3_{size}_pipelined_updates_per_s "
+            f"(S={spec.stages} M={spec.microbatches} 1f1b, mesh {pipe_mesh}, "
+            f"B={B} L={L} U={U}, {dev.platform})"
+        ),
+        "value": round(pipe_arm["updates_per_s"], 3),
+        "unit": "updates/s",
+        # reported, not gated: fake CPU devices share host cores
+        "vs_baseline": round(pipe_arm["updates_per_s"] / base["updates_per_s"], 3),
+        "updates_per_s_pipelined": round(pipe_arm["updates_per_s"], 3),
+        "updates_per_s_gspmd_baseline": round(base["updates_per_s"], 3),
+        "first_call_s_pipelined": round(pipe_arm["first_call_s"], 3),
+        "first_call_s_gspmd_baseline": round(base["first_call_s"], 3),
+        "pipeline": {
+            "stages": spec.stages,
+            "microbatches": spec.microbatches,
+            "schedule": spec.schedule,
+            "stage_names": list(stage_names),
+        },
+        # the schedule's idle fraction (S-1)/(M+S-1) — docs/pipeline.md
+        "bubble_frac": round(spec.bubble_frac, 6),
+        "mesh_shape_pipelined": pipe_arm["mesh_shape"],
+        "mesh_shape_baseline": base["mesh_shape"],
+        "steady_windows": iters,
+        # per-stage fwd/bwd wall fractions (pipeline.stage.* spans): the
+        # stage-balance tuning signal behind pipeline.stages grouping
+        "phase_breakdown": breakdown,
+        "phase_frac_sum": frac_sum,
+        # ISSUE 16 acceptance gates: compile-once across both armed steady
+        # windows + the span fractions accounting for the whole window
+        "steady_compiles": steady_compiles,
+        "cache_size_one": cache_ok,
+        "gate_failed": not (steady_compiles == 0 and cache_ok and frac_ok),
+    }
+
+
 def bench_fault_overhead() -> dict:
     """Zero-overhead gate for the fault-injection layer (docs/resilience.md).
 
@@ -1371,6 +1596,8 @@ def _run_bench() -> dict:
         return bench_env()
     if target == "sebulba":
         return bench_sebulba()
+    if target == "pipeline":
+        return bench_pipeline()
     if target in BASELINE_CPU_WALL_CLOCK_S:
         return bench_cpu_wall_clock(target)
     return bench_dreamer_v3()

@@ -344,6 +344,41 @@ class WorldModel(nn.Module):
         z = OneHotCategorical(post_logits, unimix=self.unimix).rsample(key)
         return h, z.reshape(B, self.stoch_flat), post_logits, prior_logits
 
+    def dynamic_noise(
+        self,
+        prev_h: jax.Array,
+        prev_z: jax.Array,
+        prev_action: jax.Array,
+        embed: jax.Array,
+        is_first: jax.Array,
+        noise: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+        """:meth:`dynamic` with pre-drawn sampling noise instead of a key —
+        the pipeline sample-invariance form (parallel/pipeline.py).
+
+        ``noise`` is a row-slice of ``OneHotCategorical.sample_noise`` drawn
+        at the FULL batch's posterior-logits shape with the same key
+        :meth:`dynamic` would consume, which makes this bit-identical to
+        :meth:`dynamic` on the corresponding batch rows regardless of how
+        the batch was microbatched (argmax is rowwise)."""
+        B = prev_h.shape[0]
+        h0, z0 = self.initial_state(B)
+        mask = 1.0 - is_first  # (B, 1)
+        prev_h = prev_h * mask + h0 * is_first
+        prev_z = prev_z * mask + z0 * is_first
+        prev_action = prev_action * mask
+        h = self.recurrent_model(prev_h, jnp.concatenate([prev_z, prev_action], -1))
+        h = h.astype(jnp.float32)  # fp32 carried state under bf16 compute
+        prior_logits = self._logits_reshape(self.transition_model(h))
+        if self.decoupled_rssm:
+            post_logits = self._logits_reshape(self.representation_model(embed))
+        else:
+            post_logits = self._logits_reshape(
+                self.representation_model(jnp.concatenate([h, embed], -1))
+            )
+        z = OneHotCategorical(post_logits, unimix=self.unimix).rsample_from_noise(noise)
+        return h, z.reshape(B, self.stoch_flat), post_logits, prior_logits
+
     def posterior_decoupled(self, embed: jax.Array) -> jax.Array:
         """DecoupledRSSM posterior logits from the embedding ALONE — batched
         over all timesteps at once (the whole point of the variant on TPU:

@@ -61,6 +61,15 @@ from sheeprl_tpu.data.device_replay import (
     update_chunks,
 )
 from sheeprl_tpu.parallel.fabric import PlayerSync
+from sheeprl_tpu.parallel.pipeline import (
+    chunked_rows,
+    merge_microbatches,
+    pipeline_value_and_grad,
+    register_pipeline_metrics,
+    resolve_pipeline,
+    split_microbatches,
+    stage_batch_constraint,
+)
 from sheeprl_tpu.utils.distribution import (
     Bernoulli,
     MSEDistribution,
@@ -122,6 +131,14 @@ def dreamer_family_loop(
     identical)."""
     rank = fabric.global_rank
     key = fabric.seed_everything(cfg.seed)
+
+    # pipeline parallelism is wired through the dreamer_v3 train-phase
+    # builder only: fail HERE (build time, clear message) for the other
+    # family members, and surface the schedule shape as Pipeline/* metrics
+    pipe = resolve_pipeline(cfg)
+    pipe.check_algo(cfg.algo.name)
+    if pipe.enabled:
+        register_pipeline_metrics(pipe)
 
     log_dir = get_log_dir(fabric, cfg.root_dir, cfg.run_name, base=cfg.get("log_dir", "logs/runs"))
     logger = get_logger(fabric, cfg, log_dir)
@@ -697,28 +714,18 @@ def dreamer_family_loop(
         logger.close()
 
 
-def make_train_phase(
-    fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
-    cnn_keys, mlp_keys, is_continuous, params=None, opt_state=None,
-):
-    """Build the jitted multi-update train phase (shared with bench.py and
-    __graft_entry__.py so the benchmarked program IS the training program).
+def make_wm_stages(cfg, world_model, cnn_keys, mlp_keys):
+    """Build the world-model forward and its pipeline stage chain.
 
-    ``params``/``opt_state``: the already-placed state trees.  When given,
-    their partition-rules shardings are pinned as the program's in/out
-    shardings (``compile.state_io_shardings``) — combined with the argnum
-    0/1 donation this guarantees the optimizer state stays sharded exactly
-    like its params and both are updated in place across every window."""
+    Returns ``(wm_forward, stage_fns, stage_names)``.  Module-level (not
+    nested in :func:`make_train_phase`) so ``bench.py --mode pipeline``
+    can compile standalone per-stage programs
+    (``parallel/pipeline.py compile_stage_pair``) from exactly the
+    functions the fused train phase pipelines.
+    """
     obs_keys = tuple(cnn_keys) + tuple(mlp_keys)
     stoch_flat = world_model.stoch_flat
     rec_size = cfg.algo.world_model.recurrent_model.recurrent_state_size
-    horizon = int(cfg.algo.horizon)
-    gamma = float(cfg.algo.gamma)
-    lmbda = float(cfg.algo.lmbda)
-    tau = float(cfg.algo.critic.tau)
-    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
-    ent_coef = float(cfg.algo.actor.ent_coef)
-    moments_cfg = cfg.algo.actor.moments
     wm_loss_cfg = dict(
         kl_dynamic=float(cfg.algo.world_model.kl_dynamic),
         kl_representation=float(cfg.algo.world_model.kl_representation),
@@ -726,28 +733,76 @@ def make_train_phase(
         kl_regularizer=float(cfg.algo.world_model.kl_regularizer),
         continue_scale_factor=float(cfg.algo.world_model.continue_scale_factor),
     )
-    # algo.remat: rematerialize the sequential scan bodies on the backward
-    # pass (jax.checkpoint) — trades ~1 extra forward of the cell for not
-    # storing L (resp. horizon) copies of its intermediates in HBM, the
-    # standard lever for fitting bigger batches/sizes on-chip
     remat = bool(cfg.algo.get("remat", False))
 
     def maybe_remat(f):
         return jax.checkpoint(f) if remat else f
 
-    def wm_forward(wm_params, data, k):
-        """Encoder + RSSM scan + heads → loss and latents for behavior."""
+    pipe = resolve_pipeline(cfg)
+
+    # The world-model forward is factored into its pipeline stage map
+    # (encoder → RSSM → heads/decoder, parallel/pipeline.py): ``_encode``,
+    # ``_rssm_inputs`` and ``_heads_losses`` are shared verbatim by the
+    # monolithic ``wm_forward`` (pipeline off — op-for-op the pre-pipeline
+    # program) and by the per-microbatch stage functions (pipeline on).  The
+    # ONLY computation the two paths do differently is where posterior
+    # sampling noise is drawn: ``wm_forward`` samples inside the scan at
+    # batch shape (``WorldModel.dynamic``), the stages consume pre-drawn
+    # full-batch noise row-sliced per microbatch
+    # (``WorldModel.dynamic_noise`` — the sample-invariance law, so both
+    # paths draw bit-identical posterior samples).
+
+    def _encode(wm_params, data):
+        """Stage 1 — normalize + encode: → (obs, embed (L, B, E))."""
         L, B = data["rewards"].shape
         obs = normalize_obs_block(data, cnn_keys, obs_keys)
         flat_obs = {kk: v.reshape((L * B,) + v.shape[2:]) for kk, v in obs.items()}
         embed = world_model.apply(wm_params, flat_obs, method=WorldModel.encode)
-        embed = embed.reshape(L, B, -1)
+        return obs, embed.reshape(L, B, -1)
 
+    def _rssm_inputs(data):
         # shifted actions: h_t consumes a_{t-1} (reference: dreamer_v3.py:105)
         actions = jnp.concatenate(
             [jnp.zeros_like(data["actions"][:1]), data["actions"][:-1]], axis=0
         )
         is_first = data["is_first"].at[0].set(1.0)[..., None]
+        return actions, is_first
+
+    def _heads_losses(wm_params, data, obs, latents, post_logits, prior_logits):
+        """Stage 3 — decoder/reward/continue heads + world-model loss."""
+        L, B = data["rewards"].shape
+        flat_latents = latents.reshape(L * B, -1)
+
+        recon = world_model.apply(wm_params, flat_latents, method=WorldModel.decode)
+        obs_log_probs = {}
+        for kk in cnn_keys:
+            dist = MSEDistribution(recon[kk].reshape(obs[kk].shape), event_dims=3)
+            obs_log_probs[kk] = dist.log_prob(obs[kk])
+        for kk in mlp_keys:
+            dist = SymlogDistribution(recon[kk].reshape(L, B, -1), event_dims=1)
+            obs_log_probs[kk] = dist.log_prob(obs[kk])
+
+        reward_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.reward_logits)
+        pr = TwoHotEncodingDistribution(reward_logits.reshape(L, B, -1), dims=1)
+        reward_lp = pr.log_prob(data["rewards"][..., None])
+
+        cont_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.continue_logits)
+        pc = Bernoulli(cont_logits.reshape(L, B), event_dims=0)
+        cont_lp = pc.log_prob(1.0 - data["terminated"])
+
+        loss, aux = world_model_loss(
+            obs_log_probs, reward_lp, cont_lp, post_logits, prior_logits, **wm_loss_cfg
+        )
+        aux["latents"] = latents
+        aux["post_logits"] = post_logits
+        aux["prior_logits"] = prior_logits
+        return loss, aux
+
+    def wm_forward(wm_params, data, k):
+        """Encoder + RSSM scan + heads → loss and latents for behavior."""
+        L, B = data["rewards"].shape
+        obs, embed = _encode(wm_params, data)
+        actions, is_first = _rssm_inputs(data)
 
         h0 = jnp.zeros((B, rec_size))
         z0 = jnp.zeros((B, stoch_flat))
@@ -786,32 +841,166 @@ def make_train_phase(
                 maybe_remat(step), (h0, z0), (embed, actions, is_first, keys)
             )
         latents = jnp.concatenate([zs, hs], -1)  # (L, B, stoch+rec)
-        flat_latents = latents.reshape(L * B, -1)
+        return _heads_losses(wm_params, data, obs, latents, post_logits, prior_logits)
 
-        recon = world_model.apply(wm_params, flat_latents, method=WorldModel.decode)
-        obs_log_probs = {}
-        for kk in cnn_keys:
-            dist = MSEDistribution(recon[kk].reshape(obs[kk].shape), event_dims=3)
-            obs_log_probs[kk] = dist.log_prob(obs[kk])
-        for kk in mlp_keys:
-            dist = SymlogDistribution(recon[kk].reshape(L, B, -1), event_dims=1)
-            obs_log_probs[kk] = dist.log_prob(obs[kk])
+    # ---- pipeline stage functions (parallel/pipeline.py chain shapes) ----
+    # const per microbatch: {"data": dict of (L, b, *), "noise": (L, b, S, D)}
 
-        reward_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.reward_logits)
-        pr = TwoHotEncodingDistribution(reward_logits.reshape(L, B, -1), dims=1)
-        reward_lp = pr.log_prob(data["rewards"][..., None])
+    def _enc_stage(wm_params, _carry, const):
+        _, embed = _encode(wm_params, const["data"])
+        return embed
 
-        cont_logits = world_model.apply(wm_params, flat_latents, method=WorldModel.continue_logits)
-        pc = Bernoulli(cont_logits.reshape(L, B), event_dims=0)
-        cont_lp = pc.log_prob(1.0 - data["terminated"])
+    def _rssm_stage(wm_params, embed, const):
+        data, noise = const["data"], const["noise"]
+        L, B = data["rewards"].shape
+        actions, is_first = _rssm_inputs(data)
+        h0 = jnp.zeros((B, rec_size))
+        z0 = jnp.zeros((B, stoch_flat))
+        if world_model.decoupled_rssm:
+            post_logits = world_model.apply(
+                wm_params, embed.reshape(L * B, -1), method=WorldModel.posterior_decoupled
+            ).reshape(L, B, world_model.stochastic_size, world_model.discrete_size)
+            zs = jax.vmap(
+                lambda lg, nz: OneHotCategorical(
+                    lg, unimix=world_model.unimix
+                ).rsample_from_noise(nz)
+            )(post_logits, noise).reshape(L, B, stoch_flat)
+            prev_zs = jnp.concatenate([jnp.zeros_like(zs[:1]), zs[:-1]], 0)
 
-        loss, aux = world_model_loss(
-            obs_log_probs, reward_lp, cont_lp, post_logits, prior_logits, **wm_loss_cfg
-        )
-        aux["latents"] = latents
-        aux["post_logits"] = post_logits
-        aux["prior_logits"] = prior_logits
-        return loss, aux
+            def step(h, xs):
+                prev_z, act_t, first_t = xs
+                h, prior_logits = world_model.apply(
+                    wm_params, h, prev_z, act_t, first_t, method=WorldModel.recurrent_prior
+                )
+                return h, (h, prior_logits)
+
+            _, (hs, prior_logits) = jax.lax.scan(maybe_remat(step), h0, (prev_zs, actions, is_first))
+        else:
+            def step(carry, xs):
+                h, z = carry
+                embed_t, act_t, first_t, nz_t = xs
+                h, z, post_logits, prior_logits = world_model.apply(
+                    wm_params, h, z, act_t, embed_t, first_t, nz_t,
+                    method=WorldModel.dynamic_noise,
+                )
+                return (h, z), (h, z, post_logits, prior_logits)
+
+            _, (hs, zs, post_logits, prior_logits) = jax.lax.scan(
+                maybe_remat(step), (h0, z0), (embed, actions, is_first, noise)
+            )
+        latents = jnp.concatenate([zs, hs], -1)
+        return latents, post_logits, prior_logits
+
+    def _heads_stage(wm_params, carry, const):
+        latents, post_logits, prior_logits = carry
+        data = const["data"]
+        # obs recomputed from the const slice (cheap normalize) instead of
+        # carried from stage 1: keeps the stage chain linear — no
+        # encoder→heads skip buffer alive across the whole 1F1B window
+        obs = normalize_obs_block(data, cnn_keys, obs_keys)
+        return _heads_losses(wm_params, data, obs, latents, post_logits, prior_logits)
+
+    # stage grouping: the dreamer stage map has 3 units; pipeline.stages
+    # picks how they fuse onto mesh sub-groups (docs/pipeline.md)
+    if pipe.stages >= 3:
+        if pipe.stages > 3:
+            raise ValueError(
+                f"pipeline.stages={pipe.stages}: the dreamer_v3 stage map has "
+                "3 units (encoder, rssm, heads) — use stages in {1, 2, 3}"
+            )
+        stage_fns = (_enc_stage, _rssm_stage, _heads_stage)
+        stage_names = ("encoder", "rssm", "heads")
+    elif pipe.stages == 2:
+        def _enc_rssm_stage(wm_params, _carry, const):
+            embed = _enc_stage(wm_params, None, const)
+            return _rssm_stage(wm_params, embed, const)
+
+        stage_fns = (_enc_rssm_stage, _heads_stage)
+        stage_names = ("encoder_rssm", "heads")
+    else:
+        def _wm_stage(wm_params, _carry, const):
+            embed = _enc_stage(wm_params, None, const)
+            carry = _rssm_stage(wm_params, embed, const)
+            return _heads_stage(wm_params, carry, const)
+
+        stage_fns = (_wm_stage,)
+        stage_names = ("world_model",)
+
+    return wm_forward, stage_fns, stage_names
+
+
+def make_train_phase(
+    fabric, cfg, world_model, actor, critic, wm_opt, actor_opt, critic_opt,
+    cnn_keys, mlp_keys, is_continuous, params=None, opt_state=None,
+):
+    """Build the jitted multi-update train phase (shared with bench.py and
+    __graft_entry__.py so the benchmarked program IS the training program).
+
+    ``params``/``opt_state``: the already-placed state trees.  When given,
+    their partition-rules shardings are pinned as the program's in/out
+    shardings (``compile.state_io_shardings``) — combined with the argnum
+    0/1 donation this guarantees the optimizer state stays sharded exactly
+    like its params and both are updated in place across every window."""
+    stoch_flat = world_model.stoch_flat
+    horizon = int(cfg.algo.horizon)
+    gamma = float(cfg.algo.gamma)
+    lmbda = float(cfg.algo.lmbda)
+    tau = float(cfg.algo.critic.tau)
+    target_freq = int(cfg.algo.critic.per_rank_target_network_update_freq)
+    ent_coef = float(cfg.algo.actor.ent_coef)
+    moments_cfg = cfg.algo.actor.moments
+    # algo.remat: rematerialize the sequential scan bodies on the backward
+    # pass (jax.checkpoint) — trades ~1 extra forward of the cell for not
+    # storing L (resp. horizon) copies of its intermediates in HBM, the
+    # standard lever for fitting bigger batches/sizes on-chip
+    remat = bool(cfg.algo.get("remat", False))
+
+    def maybe_remat(f):
+        return jax.checkpoint(f) if remat else f
+
+    # pipeline.* group: stage split + 1F1B microbatch schedule for the
+    # world-model update, row-chunking for the imagination head evals
+    # (parallel/pipeline.py, docs/pipeline.md); the disabled spec keeps the
+    # monolithic pre-pipeline program op-for-op
+    pipe = resolve_pipeline(cfg)
+    pipe.check_algo(cfg.algo.name)
+    imag_chunks = pipe.imagination_microbatches
+
+    wm_forward, stage_fns, stage_names = make_wm_stages(
+        cfg, world_model, cnn_keys, mlp_keys
+    )
+
+    if pipe.enabled:
+        s_z, d_z = world_model.stochastic_size, world_model.discrete_size
+        batch_aux = ("latents", "post_logits", "prior_logits")
+        constrain = stage_batch_constraint(fabric.mesh, fabric.data_axis, batch_axis=1)
+
+        def wm_value_and_grad(wm_params, data, k_wm):
+            L, B = data["rewards"].shape
+            keys = jax.random.split(k_wm, L)
+            # full-batch noise with the baseline's exact per-timestep keys;
+            # microbatch slices then sample the exact bits wm_forward would
+            noise = jax.vmap(
+                lambda kk: OneHotCategorical.sample_noise(kk, (B, s_z, d_z))
+            )(keys)
+            consts = split_microbatches(
+                {"data": data, "noise": noise}, pipe.microbatches, axis=1
+            )
+            loss, aux_m, grads = pipeline_value_and_grad(
+                stage_fns, wm_params, consts,
+                microbatches=pipe.microbatches, stage_names=stage_names,
+                constrain=constrain,
+            )
+            # reassemble: batch-shaped aux un-microbatches to (L, B, *);
+            # per-microbatch scalar means average to the batch mean
+            aux = {
+                kk: merge_microbatches(v, axis=1) if kk in batch_aux else v.mean(0)
+                for kk, v in aux_m.items()
+            }
+            return (loss, aux), grads
+    else:
+        def wm_value_and_grad(wm_params, data, k_wm):
+            return jax.value_and_grad(wm_forward, has_aux=True)(wm_params, data, k_wm)
 
     def behavior_update(p, o_state, moments, latents, terminated, k):
         """Imagination rollout + actor and critic updates."""
@@ -838,18 +1027,32 @@ def make_train_phase(
             # states z0, z'1, ..., z'H (reference diagram, dreamer_v3.py:222-232)
             _, (traj, actions_seq) = jax.lax.scan(maybe_remat(img_step), (h0, z0), keys)
             # predictions over the whole imagined trajectory
+            # the imagination batch's wide head evals, row-chunked under
+            # pipeline.imagination_microbatches (chunked_rows is fn(x)
+            # verbatim at 1 — per-row values are unchanged either way)
             flat_traj = traj.reshape((horizon + 1) * n, -1)
             rewards = TwoHotEncodingDistribution(
-                world_model.apply(p["world_model"], flat_traj, method=WorldModel.reward_logits)
-                .reshape(horizon + 1, n, -1),
+                chunked_rows(
+                    lambda x: world_model.apply(
+                        p["world_model"], x, method=WorldModel.reward_logits
+                    ),
+                    flat_traj, imag_chunks,
+                ).reshape(horizon + 1, n, -1),
                 dims=1,
             ).mean[..., 0]
             values = TwoHotEncodingDistribution(
-                critic.apply(p["critic"], flat_traj).reshape(horizon + 1, n, -1), dims=1
+                chunked_rows(
+                    lambda x: critic.apply(p["critic"], x), flat_traj, imag_chunks
+                ).reshape(horizon + 1, n, -1),
+                dims=1,
             ).mean[..., 0]
             continues = Bernoulli(
-                world_model.apply(p["world_model"], flat_traj, method=WorldModel.continue_logits)
-                .reshape(horizon + 1, n)
+                chunked_rows(
+                    lambda x: world_model.apply(
+                        p["world_model"], x, method=WorldModel.continue_logits
+                    ),
+                    flat_traj, imag_chunks,
+                ).reshape(horizon + 1, n)
             ).mode()
             true_continue = (1.0 - terminated).reshape(1, n)
             continues = jnp.concatenate([true_continue, continues[1:]], 0)
@@ -897,13 +1100,17 @@ def make_train_phase(
         traj_sg = jax.lax.stop_gradient(traj[:-1])
         flat_sg = traj_sg.reshape(horizon * traj_sg.shape[1], -1)
         target_mean = TwoHotEncodingDistribution(
-            critic.apply(p["target_critic"], flat_sg).reshape(horizon, -1, cfg.algo.critic.bins),
+            chunked_rows(
+                lambda x: critic.apply(p["target_critic"], x), flat_sg, imag_chunks
+            ).reshape(horizon, -1, cfg.algo.critic.bins),
             dims=1,
         ).mean
 
         def critic_loss_fn(critic_params):
             qv = TwoHotEncodingDistribution(
-                critic.apply(critic_params, flat_sg).reshape(horizon, -1, cfg.algo.critic.bins),
+                chunked_rows(
+                    lambda x: critic.apply(critic_params, x), flat_sg, imag_chunks
+                ).reshape(horizon, -1, cfg.algo.critic.bins),
                 dims=1,
             )
             vl = -qv.log_prob(jax.lax.stop_gradient(lambda_values)[..., None])
@@ -921,9 +1128,7 @@ def make_train_phase(
         data, k = inputs  # data: dict of (L, B, *)
         k_wm, k_beh = jax.random.split(k)
 
-        (wm_l, aux), wm_grads = jax.value_and_grad(wm_forward, has_aux=True)(
-            p["world_model"], data, k_wm
-        )
+        (wm_l, aux), wm_grads = wm_value_and_grad(p["world_model"], data, k_wm)
         wm_updates, new_wm_opt = wm_opt.update(wm_grads, o_state["world_model"], p["world_model"])
         p = {**p, "world_model": optax.apply_updates(p["world_model"], wm_updates)}
         o_state = {**o_state, "world_model": new_wm_opt}

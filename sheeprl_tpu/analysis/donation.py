@@ -64,6 +64,11 @@ _COMPILE_WRAPPERS = ("compile", "compile_once", "jit")
 KNOWN_FACTORY_DONATIONS: Dict[str, Tuple[int, ...]] = {
     "fused_uniform_train": (0, 1),
     "fused_sequence_train": (0, 1),
+    # parallel/pipeline.py per-stage harness: (fwd, bwd) tuple whose bwd
+    # (position 1) donates the inter-stage activation buffer and incoming
+    # cotangent — reading a stage output again after its backward consumed
+    # it is the 1F1B use-after-donate hazard (ISSUE 16)
+    "compile_stage_pair@1": (1, 2),
 }
 
 #: callables whose result may ALIAS their first argument (the PR 7 class:

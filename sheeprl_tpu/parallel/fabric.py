@@ -395,19 +395,42 @@ class Fabric:
         return None
 
     @property
+    def pipeline_axis(self) -> Optional[str]:
+        """Name of the pipeline mesh axis, or None when the mesh has no
+        ``pipeline`` axis of size > 1
+        (``fabric.mesh_shape={data: d, pipeline: s, model: k}`` — the stage
+        sub-groups of parallel/pipeline.py, docs/pipeline.md)."""
+        if "pipeline" in self.mesh.axis_names and self.mesh.shape["pipeline"] > 1:
+            return "pipeline"
+        return None
+
+    @property
     def sharding_rules(self) -> Tuple[Any, ...]:
         """The resolved partition-rule table (``parallel/sharding.py``):
         user ``sharding.rules`` overrides prepended to the selected base
         table — the per-algo curated table under ``table: auto`` (DreamerV3
         family: RSSM dense stacks, decoder deconvs, actor/critic MLPs), or
         the legacy size-threshold fallback parameterized by the
-        ``tp_min_param_size`` compat knob."""
+        ``tp_min_param_size`` compat knob.  With a ``pipeline`` mesh axis
+        the table is composed through
+        :func:`sheeprl_tpu.parallel.pipeline.compose_pipeline_rules`: every
+        model-sharded dim tiles over the ``(pipeline, model)`` product so
+        each stage sub-group owns its weight slice."""
         if self._sharding_rules is None:
             from sheeprl_tpu.parallel.sharding import resolve_rules
 
-            self._sharding_rules = resolve_rules(
+            rules = resolve_rules(
                 self.sharding_cfg, tp_min_param_size=self.tp_min_param_size
             )
+            if self.pipeline_axis is not None:
+                from sheeprl_tpu.parallel.pipeline import compose_pipeline_rules
+
+                rules = compose_pipeline_rules(
+                    rules,
+                    pipeline_axis=self.pipeline_axis,
+                    has_model=self.model_axis is not None,
+                )
+            self._sharding_rules = rules
         return self._sharding_rules
 
     def param_sharding(
@@ -430,19 +453,19 @@ class Fabric:
         ``min_size`` is the ``tp_min_param_size`` compat hook: passing it
         explicitly selects the legacy size-threshold table at that
         threshold, bypassing the configured rules."""
-        axis = self.model_axis
-        if axis is None:
+        if self.model_axis is None and self.pipeline_axis is None:
             return jax.tree.map(lambda _: self.replicated, tree)
         if self.num_processes > 1:
             # the player-sync path (copy_to/to_host) materializes params on
             # one device from the process-local replica — a column-sharded
-            # array has no such replica across hosts.  Multi-host TP needs a
-            # gather-to-host protocol; fail with the fix spelled out instead
-            # of crashing at the first player refresh.
+            # array has no such replica across hosts.  Multi-host TP/PP needs
+            # a gather-to-host protocol; fail with the fix spelled out
+            # instead of crashing at the first player refresh.
             raise NotImplementedError(
-                "tensor parallelism (fabric.mesh_shape with a 'model' axis) is "
-                "currently single-controller only; multi-host runs must use a "
-                "pure data mesh (drop mesh_shape or set model: 1)"
+                "model sharding (fabric.mesh_shape with a 'model' or 'pipeline' "
+                "axis) is currently single-controller only; multi-host runs "
+                "must use a pure data mesh (drop mesh_shape or set model: 1 "
+                "and pipeline: 1)"
             )
         from sheeprl_tpu.parallel import sharding as shd
 
@@ -898,8 +921,13 @@ def _u8_to_obj(arr: np.ndarray) -> Any:
     return pickle.loads(arr.tobytes())
 
 
+# process-wide latch for the tp_min_param_size deprecation notice
+_TP_MIN_PARAM_SIZE_WARNED = False
+
+
 def build_fabric(cfg: Any) -> Fabric:
     """Instantiate the runtime from ``cfg.fabric`` (+ register callbacks)."""
+    global _TP_MIN_PARAM_SIZE_WARNED
     fab_cfg = cfg.fabric
     cache_dir = fab_cfg.get("compilation_cache_dir")
     if cache_dir:
@@ -920,9 +948,16 @@ def build_fabric(cfg: Any) -> Fabric:
                 reset_cache()
             except Exception:
                 pass
-    if "tp_min_param_size" in fab_cfg:
+    if "tp_min_param_size" in fab_cfg and not _TP_MIN_PARAM_SIZE_WARNED:
+        # fire ONCE per process, not per build_fabric call: long runs build
+        # fabrics repeatedly (supervisor relaunch probes, bench A/B arms,
+        # player clones) and a per-call DeprecationWarning floods the log —
+        # and "default"-filtered warnings dedupe per call SITE, which this
+        # single callsite defeats.  Pinned by
+        # tests/test_sharding/test_deprecation.py.
         import warnings
 
+        _TP_MIN_PARAM_SIZE_WARNED = True
         warnings.warn(
             "fabric.tp_min_param_size is deprecated: parameter placement is "
             "now decided by the sharding rules engine (sharding.rules / "

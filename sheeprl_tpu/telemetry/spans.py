@@ -18,6 +18,14 @@ Span taxonomy (docs/telemetry.md):
   sampling included — it is part of the same executable)
 * ``param.broadcast``  — learner→actor param publication
 * ``ckpt.snapshot``    — checkpoint serialize+write (writer thread)
+* ``pipeline.stage.<name>.fwd`` / ``.bwd`` — per-stage forward/backward
+  wall time of the pipelined world-model update, measured by
+  ``bench.py --mode pipeline``'s standalone stage programs
+  (``parallel/pipeline.py compile_stage_pair``); inside the fused train
+  phase the stages appear as ``pipeline.<name>`` ``named_scope``s in
+  device traces instead (one dispatch = one ``update.dispatch`` span).
+  The derived first-class metric is ``Pipeline/bubble_frac`` — the
+  schedule's idle fraction ``(S-1)/(M+S-1)`` (docs/pipeline.md).
 
 Wiring is centralized: ``utils.timer`` bridges the two phase timers every
 loop already has (:data:`TIMER_PHASES`), and the sebulba runner /

@@ -113,6 +113,35 @@ class OneHotCategorical:
         probs = self.probs
         return sample + probs - jax.lax.stop_gradient(probs)
 
+    # -- noise-hoisted sampling (pipeline sample-invariance law) ----------
+    #
+    # ``jax.random.categorical(key, logits)`` IS ``argmax(logits + gumbel)``
+    # with the gumbel drawn at ``logits.shape``/``logits.dtype`` — the split
+    # below is bit-identical to ``sample(key)`` when the noise comes from
+    # ``sample_noise(key, logits.shape, logits.dtype)`` (pinned by
+    # tests/test_parallel/test_pipeline.py).  Because argmax is rowwise, the
+    # noise can be drawn ONCE at full batch shape and row-sliced per
+    # microbatch: pipelined stages sample the exact bits the full-batch
+    # baseline would, so schedule choices never become numerics changes
+    # (sheeprl_tpu/parallel/pipeline.py module docs).
+
+    @staticmethod
+    def sample_noise(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+        """The sampling noise ``sample(key)`` would consume for logits of
+        this shape/dtype — hoistable because it is logits-independent."""
+        return jax.random.gumbel(key, shape, dtype)
+
+    def sample_from_noise(self, noise: jax.Array) -> jax.Array:
+        """``sample`` with pre-drawn noise (any row-slice thereof)."""
+        idx = jnp.argmax(self.logits + noise, axis=-1)
+        return jax.nn.one_hot(idx, self.num_classes, dtype=self.logits.dtype)
+
+    def rsample_from_noise(self, noise: jax.Array) -> jax.Array:
+        """``rsample`` with pre-drawn noise (any row-slice thereof)."""
+        sample = self.sample_from_noise(noise)
+        probs = self.probs
+        return sample + probs - jax.lax.stop_gradient(probs)
+
     def log_prob(self, value: jax.Array) -> jax.Array:
         return jnp.sum(value * self.logits, axis=-1)
 

@@ -148,6 +148,69 @@ class TestUseAfterDonate:
         assert lint_snippet(code) == []
 
 
+class TestPipelineStageDonation:
+    """The ISSUE 16 hazard class: compile_stage_pair's backward donates the
+    inter-stage activation buffer (arg 1) and the incoming cotangent (arg 2)
+    — donate a stage-N output, read it again for the 1F1B backward, and the
+    buffer is gone.  Curated-table entry 'compile_stage_pair@1' makes the
+    cross-module call sites (bench.py) visible to the flow scan."""
+
+    def test_violating_activation_read_after_backward(self):
+        code = """
+        def bench_stage(fabric, stage_fn, params, x):
+            fwd, bwd = compile_stage_pair(fabric, stage_fn, name="s0")
+            act = fwd(params, x)
+            dy = fwd(params, x)
+            dx = bwd(params, act, dy)
+            return act.sum() + dx.sum()  # READ
+        """
+        findings = lint_snippet(code)
+        assert rules_of(findings) == ["use-after-donate"]
+        assert findings[0].line == line_of(code, "# READ")
+        assert "'act'" in findings[0].message
+
+    def test_violating_cotangent_reused_across_iterations(self):
+        # dy built once, donated every pass: dead buffer from pass 2 on
+        code = """
+        def bench_stage(fabric, stage_fn, params, x, steps):
+            fwd, bwd = compile_stage_pair(fabric, stage_fn, name="s0")
+            dy = fwd(params, x)
+            for _ in range(steps):
+                act = fwd(params, x)
+                dx = bwd(params, act, dy)  # DONATE
+            return dx
+        """
+        findings = lint_snippet(code)
+        assert rules_of(findings) == ["use-after-donate"]
+        assert findings[0].line == line_of(code, "# DONATE")
+        assert "'dy'" in findings[0].message
+
+    def test_clean_canonical_rebinding_loop(self):
+        # the sanctioned shape: act and dy rebound from fwd every pass,
+        # params (arg 0) is NOT donated by the backward
+        code = """
+        def bench_stage(fabric, stage_fn, params, x, steps):
+            fwd, bwd = compile_stage_pair(fabric, stage_fn, name="s0")
+            for _ in range(steps):
+                act = fwd(params, x)
+                dy = fwd(params, x)
+                dx = bwd(params, act, dy)
+            return params, dx
+        """
+        assert lint_snippet(code) == []
+
+    def test_clean_forward_only(self):
+        # fwd (tuple position 0) donates nothing: reuse is legal
+        code = """
+        def bench_stage(fabric, stage_fn, params, x, steps):
+            fwd, bwd = compile_stage_pair(fabric, stage_fn, name="s0")
+            act = fwd(params, x)
+            act2 = fwd(params, x)
+            return act, act2
+        """
+        assert lint_snippet(code) == []
+
+
 # ---------------------------------------------------------------------------
 # rule 1b: donation-borrowed-buffer
 # ---------------------------------------------------------------------------

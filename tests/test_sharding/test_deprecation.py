@@ -1,0 +1,84 @@
+"""The ``fabric.tp_min_param_size`` deprecation path (ISSUE 16 satellite).
+
+PR 7 demoted the knob to parameterizing the legacy ``size_threshold``
+fallback table, with a ``DeprecationWarning`` on ``build_fabric``.  Two pins:
+
+* the warning fires ONCE per process, not per call — long runs build
+  fabrics repeatedly (supervisor relaunch probes, bench A/B arms, player
+  clones), and Python's per-call-site warning dedup does not help a single
+  hot callsite (``simplefilter("always")`` below defeats it on purpose:
+  the dedup under test is build_fabric's own latch);
+* ``sharding.table=size_threshold`` still resolves, and the knob still
+  reaches the threshold: a kernel at the threshold shards, one below it
+  replicates.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from sheeprl_tpu.config.compose import compose
+from sheeprl_tpu.parallel import fabric as fabric_mod
+from sheeprl_tpu.parallel import sharding as shd
+from sheeprl_tpu.parallel.fabric import build_fabric
+
+
+@pytest.fixture
+def fresh_latch():
+    """Make the test order-independent: the latch is process-wide."""
+    old = fabric_mod._TP_MIN_PARAM_SIZE_WARNED
+    fabric_mod._TP_MIN_PARAM_SIZE_WARNED = False
+    yield
+    fabric_mod._TP_MIN_PARAM_SIZE_WARNED = old
+
+
+def _cfg(*extra):
+    return compose([
+        "exp=dreamer_v3", "env=dummy", "env.id=discrete_dummy",
+        "fabric.accelerator=cpu", "fabric.devices=1", *extra,
+    ])
+
+
+def test_tp_min_param_size_warns_exactly_once_per_process(fresh_latch):
+    cfg = _cfg("fabric.tp_min_param_size=65536")
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build_fabric(cfg)
+        build_fabric(cfg)  # supervisor probe / bench second arm / clone
+    dep = [w for w in caught if issubclass(w.category, DeprecationWarning)
+           and "tp_min_param_size" in str(w.message)]
+    assert len(dep) == 1, [str(w.message) for w in caught]
+    # and the message points at the replacement surface
+    assert "sharding" in str(dep[0].message)
+
+
+def test_tp_min_param_size_silent_when_unset(fresh_latch):
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        build_fabric(_cfg())
+    assert not [w for w in caught if "tp_min_param_size" in str(w.message)]
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8, reason="needs 8 virtual devices")
+def test_size_threshold_table_still_resolves_and_honors_knob(fresh_latch):
+    cfg = _cfg(
+        "fabric.devices=8",
+        "fabric.mesh_shape={data: 2, model: 4}",
+        "sharding.table=size_threshold",
+        "fabric.tp_min_param_size=4096",
+    )
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", DeprecationWarning)
+        fabric = build_fabric(cfg)
+    rules = fabric.sharding_rules
+    assert len(rules) == 1 and rules[0][0] == r".*" and callable(rules[0][1])
+    tree = {
+        "big/kernel": np.zeros((64, 64), np.float32),     # 4096 = threshold
+        "small/kernel": np.zeros((32, 32), np.float32),   # below
+    }
+    specs = shd.partition_specs(rules, tree, fabric.mesh)
+    assert specs["big/kernel"] == P(None, "model")
+    assert specs["small/kernel"] == P()
