@@ -590,6 +590,38 @@ def bench_cpu_wall_clock(algo: str) -> dict:
     }
 
 
+def _tiny_serve_ckpt(algo: str, prefix: str = "bench_serve_") -> str:
+    """A committed tiny-dryrun checkpoint to serve from (shared by the
+    ``serve`` and ``serve_fleet`` benches)."""
+    import tempfile
+
+    from sheeprl_tpu.cli import run
+    from tests.ckpt_utils import find_checkpoints
+
+    log_dir = tempfile.mkdtemp(prefix=prefix)
+    env_id = "continuous_dummy" if algo.startswith("sac") else "discrete_dummy"
+    args = [
+        f"exp={algo}", "env=dummy", f"env.id={env_id}", "dry_run=True",
+        "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
+        "fabric.devices=1", "metric.log_level=0", "checkpoint.every=1",
+        "buffer.memmap=False", "algo.learning_starts=0",
+        f"log_dir={log_dir}", "print_config=False", "algo.run_test=False",
+    ]
+    if algo == "dreamer_v3":
+        args += [
+            "algo=dreamer_v3_XS", "algo.per_rank_batch_size=2",
+            "algo.per_rank_sequence_length=8", "algo.horizon=4",
+            "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
+            "algo.world_model.encoder.cnn_channels_multiplier=4",
+            "algo.dense_units=16",
+            "algo.world_model.recurrent_model.recurrent_state_size=16",
+            "algo.world_model.transition_model.hidden_size=16",
+            "algo.world_model.representation_model.hidden_size=16",
+        ]
+    run(args)
+    return str(find_checkpoints(log_dir)[-1])
+
+
 def bench_serve() -> dict:
     """Policy-as-a-service load benchmark (``--mode serve``).
 
@@ -602,39 +634,12 @@ def bench_serve() -> dict:
     ``steady_compiles`` must be 0: the batch ladder is AOT-warmed before
     the timed window, so a nonzero value means a shape escaped the ladder.
     """
-    import tempfile
     import threading
 
     import numpy as np
 
     algo = os.environ.get("BENCH_SERVE_ALGO", "ppo")
-    ckpt = os.environ.get("BENCH_SERVE_CKPT")
-    if not ckpt:
-        from sheeprl_tpu.cli import run
-        from tests.ckpt_utils import find_checkpoints
-
-        log_dir = tempfile.mkdtemp(prefix="bench_serve_")
-        env_id = "continuous_dummy" if algo.startswith("sac") else "discrete_dummy"
-        args = [
-            f"exp={algo}", "env=dummy", f"env.id={env_id}", "dry_run=True",
-            "env.num_envs=2", "env.sync_env=True", "env.capture_video=False",
-            "fabric.devices=1", "metric.log_level=0", "checkpoint.every=1",
-            "buffer.memmap=False", "algo.learning_starts=0",
-            f"log_dir={log_dir}", "print_config=False", "algo.run_test=False",
-        ]
-        if algo == "dreamer_v3":
-            args += [
-                "algo=dreamer_v3_XS", "algo.per_rank_batch_size=2",
-                "algo.per_rank_sequence_length=8", "algo.horizon=4",
-                "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[state]",
-                "algo.world_model.encoder.cnn_channels_multiplier=4",
-                "algo.dense_units=16",
-                "algo.world_model.recurrent_model.recurrent_state_size=16",
-                "algo.world_model.transition_model.hidden_size=16",
-                "algo.world_model.representation_model.hidden_size=16",
-            ]
-        run(args)
-        ckpt = find_checkpoints(log_dir)[-1]
+    ckpt = os.environ.get("BENCH_SERVE_CKPT") or _tiny_serve_ckpt(algo)
 
     from sheeprl_tpu.serve import PolicyService
     from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
@@ -697,6 +702,164 @@ def bench_serve() -> dict:
         "steady_compiles": exe_after - exe_before,
         "compile_executables": exe_after,
         "compile_time_s": round(compile_s, 3),
+    }
+
+
+def bench_serve_fleet() -> dict:
+    """Fault-tolerant serving-fleet benchmark (``--mode serve_fleet``,
+    ISSUE 17).
+
+    Three phases over REAL replica processes (``LocalFleet`` spawning
+    ``python -m sheeprl_tpu.serve``) behind a ``FleetRouter`` front:
+
+    * **A (baseline)** — a 1-replica fleet under ``BENCH_FLEET_CLIENTS``
+      threads x ``BENCH_FLEET_REQUESTS`` acts: the router-included
+      single-replica actions/s;
+    * **B (scaling)** — the same load over ``BENCH_FLEET_REPLICAS``
+      replicas; per-replica efficiency = thr_R / (thr_1 * R) must reach
+      ``BENCH_FLEET_SCALE_FLOOR`` (default 0.8);
+    * **C (chaos)** — the same fleet with one replica SIGKILLed
+      mid-window: zero dropped requests, every session completes.
+
+    ``gate_failed`` on any drop, any lost session, or sub-floor scaling.
+    """
+    import signal
+    import threading
+
+    import numpy as np
+
+    from sheeprl_tpu.serve.client import PolicyClient
+    from sheeprl_tpu.serve.fleet import FleetRouter, FleetServer, LocalFleet
+
+    algo = os.environ.get("BENCH_SERVE_ALGO", "ppo")
+    ckpt = os.environ.get("BENCH_SERVE_CKPT") or _tiny_serve_ckpt(algo, "bench_fleet_")
+    replicas = max(2, int(os.environ.get("BENCH_FLEET_REPLICAS", 2)))
+    clients = int(os.environ.get("BENCH_FLEET_CLIENTS", 16))
+    per_client = int(os.environ.get("BENCH_FLEET_REQUESTS", 64))
+    floor = float(os.environ.get("BENCH_FLEET_SCALE_FLOOR", 0.8))
+    cfg = {"serve": {"fleet": {"health_poll_s": 0.2, "eject_threshold": 2, "readmit_s": 0.5}}}
+    overrides = ["serve.batch_ladder=[1,8,16]", "serve.max_wait_ms=2"]
+
+    def run_load(url: str, kill_after_s: float = -1.0, fleet=None, sessions=False):
+        """(elapsed_s, completed_sessions, errors) for one client storm.
+
+        Scaling phases run sessionless (least-loaded dispatch spreads the
+        load evenly); the chaos phase runs session-bearing so the kill also
+        exercises sticky re-routing and session completion."""
+        barrier = threading.Barrier(clients + 1)
+        done: list = []
+        errors: list = []
+
+        def worker(wid: int) -> None:
+            client = PolicyClient(url, timeout=120.0, retries=8, retry_base_s=0.2)
+            session = f"bench-{wid}" if sessions else None
+            barrier.wait(timeout=300.0)
+            try:
+                for _ in range(per_client):
+                    client.act(obs, greedy=True, session=session)
+                done.append(wid)
+            except Exception as e:  # the gate IS "no exception"
+                errors.append(repr(e))
+
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(clients)]
+        for t in threads:
+            t.start()
+        barrier.wait(timeout=300.0)
+        t0 = time.perf_counter()
+        if kill_after_s >= 0:
+            killer = threading.Timer(
+                kill_after_s, lambda: fleet.kill(0, sig=signal.SIGKILL)
+            )
+            killer.start()
+        for t in threads:
+            t.join(600.0)
+        elapsed = time.perf_counter() - t0
+        return elapsed, len(done), errors
+
+    results: dict = {}
+    total = clients * per_client
+    for phase, n in (("single", 1), ("fleet", replicas)):
+        fleet = LocalFleet(
+            ckpt, overrides=overrides, replicas=n,
+            backoff_base_s=0.2, backoff_max_s=1.0, echo=False,
+        )
+        fleet.start()
+        server = None
+        try:
+            router = FleetRouter(fleet.addresses(), cfg)
+            fleet.attach(router)
+            server = FleetServer(router)
+            server.start()
+            if not router.wait_healthy(min_replicas=n, timeout=300.0):
+                raise RuntimeError(f"{phase}: fleet never became healthy: {router.health()}")
+            health = PolicyClient(server.url, timeout=120.0).health()
+            obs = {
+                k: np.zeros(shape, np.dtype(dt))
+                for k, (shape, dt) in health["obs_spec"].items()
+            }
+            run_load(server.url)  # settle: warm every replica + HTTP path
+            elapsed, completed, errors = run_load(server.url)
+            results[phase] = {
+                "actions_per_s": round(total / elapsed, 3),
+                "elapsed_s": round(elapsed, 3),
+                "completed_sessions": completed,
+                "dropped": len(errors),
+                "errors": errors[:3],
+            }
+            if phase == "fleet":
+                # phase C on the same fleet: kill a replica mid-window
+                elapsed, completed, errors = run_load(
+                    server.url,
+                    kill_after_s=max(0.3, elapsed / 4),
+                    fleet=fleet,
+                    sessions=True,
+                )
+                stats = router.stats()
+                results["chaos"] = {
+                    "actions_per_s": round(total / elapsed, 3),
+                    "completed_sessions": completed,
+                    "dropped": len(errors),
+                    "errors": errors[:3],
+                    "failovers": stats["failovers"],
+                    "ejects": stats["ejects"],
+                    "respawns": stats["respawns"],
+                }
+        finally:
+            if server is not None:
+                server.stop()
+            fleet.stop()
+
+    thr_1 = results["single"]["actions_per_s"]
+    thr_r = results["fleet"]["actions_per_s"]
+    efficiency = thr_r / (thr_1 * replicas) if thr_1 > 0 else 0.0
+    dropped = sum(results[p]["dropped"] for p in results)
+    lost_sessions = sum(clients - results[p]["completed_sessions"] for p in results)
+    # the scaling gate needs a host that can actually back R replica
+    # processes plus the router: on fewer cores linear scaling is
+    # physically impossible, so efficiency is reported but not gated
+    cores = os.cpu_count() or 1
+    scale_gated = cores >= replicas + 1
+    gate_failed = (
+        dropped > 0 or lost_sessions > 0 or (scale_gated and efficiency < floor)
+    )
+    label = "" if scale_gated else f" [scaling ungated: {cores} cpus for {replicas} replicas]"
+    return {
+        "metric": (
+            f"serve_fleet_{algo}_actions_per_s "
+            f"({replicas} replicas, {clients} clients x {per_client} reqs, "
+            f"SIGKILL chaos phase){label}"
+        ),
+        "value": thr_r,
+        "unit": "actions/s",
+        "vs_baseline": None,
+        "single_replica_actions_per_s": thr_1,
+        "scaling_efficiency_per_replica": round(efficiency, 3),
+        "scale_floor": floor,
+        "scale_gated": scale_gated,
+        "dropped_requests": dropped,
+        "lost_sessions": lost_sessions,
+        "phases": results,
+        "gate_failed": gate_failed,
     }
 
 
@@ -1584,6 +1747,8 @@ def _run_bench() -> dict:
         return bench_lint()
     if target == "serve":
         return bench_serve()
+    if target == "serve_fleet":
+        return bench_serve_fleet()
     if target == "replay":
         return bench_device_replay()
     if target == "fault_overhead":

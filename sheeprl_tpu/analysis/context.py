@@ -39,6 +39,7 @@ METRIC_FAMILIES: Tuple[str, ...] = (
     "Phase",       # telemetry span phase-breakdown fractions
     "Health",      # training-health sentinels
     "Serve",       # policy-as-a-service stats
+    "Fleet",       # serving-fleet router (replicas, failovers, migrations)
     "Sebulba",     # actor-learner topology queues/broadcast
     "Player",      # PlayerSync staleness
     "Telemetry",   # introspection endpoint self-metrics

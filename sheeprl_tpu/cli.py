@@ -367,6 +367,84 @@ def serve(argv: Optional[List[str]] = None) -> None:
     server.serve_forever()
 
 
+def serve_fleet(argv: Optional[List[str]] = None) -> None:
+    """Serve a committed checkpoint through the fault-tolerant fleet:
+    N replica processes behind one health-checked router.
+
+    Usage:
+        python -m sheeprl_tpu.serve.fleet checkpoint_path=<run-dir> \\
+            [serve.fleet.replicas=2] [serve.fleet.port=7456] [overrides...]
+
+    Prefer a run/version directory over a pinned ``step_*`` snapshot: a
+    respawned replica re-resolves ``checkpoint_path`` on its own, and a
+    pinned step would come back serving stale params after a rolling
+    reload.  See docs/serving.md "Fleet".
+    """
+    argv = list(sys.argv[1:] if argv is None else argv)
+    ckpt_override = [a for a in argv if a.startswith("checkpoint_path=")]
+    if not ckpt_override:
+        raise ConfigError("serve_fleet requires checkpoint_path=<ckpt-or-run-dir>")
+    ckpt_path = ckpt_override[0].split("=", 1)[1]
+    rest = [a for a in argv if not a.startswith("checkpoint_path=")]
+
+    from sheeprl_tpu.resilience import install_from_config, install_from_env
+    from sheeprl_tpu.serve.fleet import FleetRouter, FleetServer, LocalFleet
+    from sheeprl_tpu.serve.loader import (
+        checkpoint_root,
+        ensure_serve_config,
+        load_run_config,
+        resolve_checkpoint,
+    )
+
+    install_from_env()
+    ckpt = resolve_checkpoint(ckpt_path)
+    cfg = ensure_serve_config(load_run_config(ckpt, rest))
+    install_from_config(cfg)
+    serve_cfg = cfg.get("serve") or {}
+    fleet_cfg = serve_cfg.get("fleet") or {}
+
+    fleet = LocalFleet(
+        ckpt_path,
+        overrides=rest,
+        replicas=int(fleet_cfg.get("replicas", 2)),
+        respawn_max=int(fleet_cfg.get("respawn_max", 10)),
+        backoff_base_s=float(fleet_cfg.get("respawn_backoff_base_s", 0.5)),
+        backoff_max_s=float(fleet_cfg.get("respawn_backoff_max_s", 30.0)),
+        seed=int(cfg.get("seed", 0) or 0),
+    )
+
+    # the replicas are OUR children: SIGTERM's default handler would kill
+    # this process before the ``finally`` below reaps them, leaving N
+    # orphaned servers bound to their ports — route it through SystemExit
+    # so ``fleet.stop()`` runs and the exit is clean
+    import signal
+
+    signal.signal(signal.SIGTERM, lambda signum, frame: sys.exit(0))
+
+    fleet.start()
+    try:
+        root = checkpoint_root(ckpt) if ckpt.is_dir() else None
+        rolling = bool(fleet_cfg.get("rolling_reload", True))
+        router = FleetRouter(fleet.addresses(), cfg, ckpt_root=root if rolling else None)
+        fleet.attach(router)
+        server = FleetServer(
+            router,
+            host=str(fleet_cfg.get("host", "127.0.0.1")),
+            port=int(fleet_cfg.get("port", 7456)),
+        )
+        # flush: drills/CI parse this line off a block-buffered pipe while
+        # serve_forever() never returns to flush it naturally
+        print(
+            f"fleet router over {fleet.n} replicas on {server.url} — "
+            f"rolling reload {'on' if router.ckpt_root is not None else 'off'}, "
+            f"replicas: {', '.join(f'{rid}={url}' for rid, url in sorted(fleet.addresses().items()))}",
+            flush=True,
+        )
+        server.serve_forever()
+    finally:
+        fleet.stop()
+
+
 def registration(argv: Optional[List[str]] = None) -> None:
     """Export checkpointed models to the model store
     (reference: sheeprl/cli.py:408-450)."""

@@ -50,6 +50,8 @@ KNOWN_SITES = (
     "checkpoint.write_shard",
     "checkpoint.commit",
     "serve.http",
+    "serve.router",
+    "serve.replica",
     "fabric.copy_to",
     "replay.spill",
     "sebulba.env_worker",

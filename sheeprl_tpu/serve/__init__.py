@@ -14,7 +14,9 @@ persistent, always-warm policy endpoint:
   (double-buffered host→device transfer) without dropping in-flight
   requests;
 * ``service`` — the in-process :class:`PolicyService` API;
-* ``server``/``client`` — a stdlib HTTP surface over it.
+* ``server``/``client`` — a stdlib HTTP surface over it;
+* ``fleet``   — the fault-tolerant fleet: a health-checked router over N
+  replica processes with session-carry migration and rolling reload.
 
 See docs/serving.md for the architecture.
 """
@@ -32,6 +34,9 @@ from sheeprl_tpu.serve.service import PolicyService
 
 __all__ = [
     "AdmissionQueue",
+    "FleetRouter",
+    "FleetServer",
+    "LocalFleet",
     "PLAYER_BUILDERS",
     "PolicyClient",
     "PolicyPlayer",
@@ -48,7 +53,7 @@ __all__ = [
 ]
 
 
-def __getattr__(name):  # lazy: server/client pull in http/urllib machinery
+def __getattr__(name):  # lazy: server/client/fleet pull in http/urllib machinery
     if name == "PolicyServer":
         from sheeprl_tpu.serve.server import PolicyServer
 
@@ -57,4 +62,8 @@ def __getattr__(name):  # lazy: server/client pull in http/urllib machinery
         from sheeprl_tpu.serve.client import PolicyClient
 
         return PolicyClient
+    if name in ("FleetRouter", "FleetServer", "LocalFleet"):
+        import sheeprl_tpu.serve.fleet as fleet
+
+        return getattr(fleet, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -21,7 +21,11 @@ Error surfacing + liveness:
   advances the server-side latent carry, so a response lost on the wire
   must not be silently replayed); 429 (load shed) and other 4xx are never
   retried — they are the server telling the client to back off or fix the
-  request.
+  request.  The one exception: **503 is retried even for session-bearing
+  acts** — a 503 (single server stopping, or the fleet router's
+  ``replica_unavailable``) certifies the request was never dispatched, so
+  no carry advanced, and against a fleet router the retry lands on a
+  re-routed healthy replica (docs/serving.md "Fleet").
 """
 
 from __future__ import annotations
@@ -113,7 +117,15 @@ class PolicyClient:
 
         def transient(e: BaseException) -> bool:
             if isinstance(e, ServeRequestError):
-                # 5xx only, and only when replaying the request is safe
+                # 503 is retried even for session-bearing acts: both
+                # spellings of it (a single server mid-stop, the fleet
+                # router's replica_unavailable) mean the request was NEVER
+                # dispatched — no carry advanced, so a replay cannot
+                # double-step the episode, and the fleet router re-routes
+                # the session to a healthy replica on the retry
+                if e.status == 503:
+                    return True
+                # other 5xx only when replaying the request is safe
                 return idempotent and e.status >= 500
             # URLError (refused/reset/DNS), timeouts, dropped connections:
             # for non-idempotent requests only connection-REFUSED-class
@@ -166,6 +178,25 @@ class PolicyClient:
     def reload(self) -> Dict[str, Any]:
         """Force one commit-watch poll on the server."""
         return self._call("POST", "/v1/reload", {})
+
+    def session_carry(self, session: str) -> Optional[Dict[str, Any]]:
+        """Read a session's CRC-stamped carry snapshot (None when the
+        server has no carry for it / the player is stateless)."""
+        from urllib.parse import quote
+
+        out = self._call("GET", f"/v1/session_carry?session={quote(session, safe='')}")
+        return out.get("snapshot")
+
+    def restore_session_carry(self, session: str, snapshot: Dict[str, Any]) -> Dict[str, Any]:
+        """Install a carry snapshot under ``session`` (migration replay).
+        NOT idempotent-marked on purpose: a restore is only replayed on
+        connection-refused, matching the act contract."""
+        return self._call(
+            "POST",
+            "/v1/session_carry",
+            {"session": session, "snapshot": snapshot},
+            idempotent=False,
+        )
 
     def stats(self) -> Dict[str, Any]:
         return self._call("GET", "/v1/stats")

@@ -1,0 +1,26 @@
+"""Fault-tolerant serving fleet: health-checked router over N replicas.
+
+The robustness layer over ``sheeprl_tpu.serve`` (docs/serving.md "Fleet"):
+
+* ``router``   — :class:`FleetRouter` + :class:`FleetServer`: a stdlib-HTTP
+  front doing health-checked least-loaded dispatch, per-replica circuit
+  breakers (eject/readmit), rendezvous-hash session affinity with carry
+  migration on replica death, and fleet-wide rolling hot reload driven by
+  the same ``CommitWatcher`` machinery single servers use;
+* ``replicas`` — :class:`LocalFleet`: a local replica supervisor
+  (spawn/respawn with jittered backoff, the PR 14 supervisor pattern).
+
+One address for clients, N interchangeable replica processes behind it: a
+replica death costs at most one in-flight step, never a session.
+"""
+
+from sheeprl_tpu.serve.fleet.router import FleetRouter, FleetServer, ReplicaState, assign_replica
+from sheeprl_tpu.serve.fleet.replicas import LocalFleet
+
+__all__ = [
+    "FleetRouter",
+    "FleetServer",
+    "LocalFleet",
+    "ReplicaState",
+    "assign_replica",
+]

@@ -1,0 +1,6 @@
+"""``python -m sheeprl_tpu.serve.fleet checkpoint_path=<run-dir> [overrides...]``"""
+
+from sheeprl_tpu.cli import serve_fleet
+
+if __name__ == "__main__":
+    serve_fleet()

@@ -13,6 +13,9 @@ Endpoints (all JSON):
   → ``{"action": [...], "shape": [...], "dtype": "...", "generation": n}``
 * ``POST /v1/reset``  — ``{"session": str}`` drops a stateful episode carry
 * ``POST /v1/reload`` — force one commit-watch poll; reports if it swapped
+* ``GET  /v1/session_carry?session=x`` / ``POST /v1/session_carry`` — read /
+  install a CRC-stamped latent-carry snapshot (the fleet router's session
+  migration primitive; see docs/serving.md "Fleet")
 * ``GET  /v1/stats``  — the service's full stats dict (latency percentiles,
   batch/padding counters, reload generation, Compile/* totals)
 * ``GET  /healthz``   — liveness + model identity
@@ -69,8 +72,14 @@ class PolicyServer:
     """
 
     def __init__(self, service: Any, host: str = "127.0.0.1", port: int = 0):
+        class _ReplicaHTTPServer(ThreadingHTTPServer):
+            # a fleet router opens a connection per forwarded request (plus
+            # health probes); the stdlib default backlog of 5 RSTs
+            # connections under concurrent load
+            request_queue_size = 128
+
         self.service = service
-        self._httpd = ThreadingHTTPServer((host, port), _make_handler(service))
+        self._httpd = _ReplicaHTTPServer((host, port), _make_handler(service))
         self._httpd.daemon_threads = True
         self._thread: Optional[threading.Thread] = None
 
@@ -174,6 +183,21 @@ def _make_handler(service: Any):
                     )
                 elif self.path == "/v1/stats":
                     self._reply(200, service.stats())
+                elif self.path.startswith("/v1/session_carry"):
+                    # ?session=<id> → that session's CRC-stamped carry
+                    # snapshot (null for unknown sessions / stateless
+                    # players) — the fleet router's migration read
+                    from urllib.parse import parse_qs, urlparse
+
+                    query = parse_qs(urlparse(self.path).query)
+                    session = (query.get("session") or [""])[0]
+                    if not session:
+                        self._reply(400, {"error": "session_carry requires ?session=<id>"})
+                    else:
+                        self._reply(
+                            200,
+                            {"session": session, "snapshot": service.get_session_carry(session)},
+                        )
                 elif self.path == "/metrics":
                     # the training-side introspection contract on the serve
                     # surface: every telemetry-hub metric (Serve/* included —
@@ -219,6 +243,24 @@ def _make_handler(service: Any):
                             "checkpoint_step": service.store.step,
                         },
                     )
+                elif self.path == "/v1/session_carry":
+                    # install a migrated carry snapshot (the fleet router's
+                    # replay onto a surviving replica); validation failures
+                    # are 400s — the router must see them, not a zero carry
+                    body = self._read_json()
+                    session = str(body.get("session", ""))
+                    snapshot = body.get("snapshot")
+                    if not session or not isinstance(snapshot, dict):
+                        self._reply(
+                            400, {"error": "session_carry requires 'session' and 'snapshot'"}
+                        )
+                        return
+                    try:
+                        service.restore_session_carry(session, snapshot)
+                    except ValueError as e:
+                        self._reply(400, {"error": str(e)})
+                        return
+                    self._reply(200, {"ok": True, "session": session})
                 else:
                     self._reply(404, {"error": f"unknown path {self.path}"})
             except BrokenPipeError:
@@ -259,16 +301,20 @@ def _make_handler(service: Any):
                 self._reply(504, {"error": str(e)})
                 return
             action = np.asarray(action)
-            self._reply(
-                200,
-                {
-                    "action": encode_array(action, packed=bool(body.get("packed"))),
-                    "shape": list(action.shape),
-                    "dtype": str(action.dtype),
-                    "generation": service.store.generation,
-                    "checkpoint_step": service.store.step,
-                },
-            )
+            payload = {
+                "action": encode_array(action, packed=bool(body.get("packed"))),
+                "shape": list(action.shape),
+                "dtype": str(action.dtype),
+                "generation": service.store.generation,
+                "checkpoint_step": service.store.step,
+            }
+            session = body.get("session")
+            if body.get("return_carry") and session is not None:
+                # fleet carry mirroring: the POST-step carry rides the act
+                # response, so the router's mirror is updated atomically
+                # with the step it reflects (no probe race window)
+                payload["carry"] = service.get_session_carry(str(session))
+            self._reply(200, payload)
 
         def _safe_error(self, code: int, e: Exception) -> None:
             try:

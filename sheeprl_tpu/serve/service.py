@@ -196,6 +196,68 @@ class PolicyService:
         with self._sessions_lock:
             self._sessions.pop(session, None)
 
+    # -- carry migration (the fleet router's failover primitive) -------------
+    def get_session_carry(self, session: str) -> Optional[Dict[str, Any]]:
+        """Host-side, CRC-stamped snapshot of one session's latent carry.
+
+        The wire format the fleet router mirrors and replays onto a
+        surviving replica when this one dies (docs/serving.md "Fleet"):
+        packed base64 leaves in ``carry_spec`` order plus a CRC over the
+        raw buffers, so a torn mirror cannot silently resurrect a session
+        with a corrupted latent state.  Returns None for unknown sessions
+        and for stateless players (nothing to migrate).
+        """
+        if not self.player.stateful:
+            return None
+        with self._sessions_lock:
+            carry = self._sessions.get(session)
+        if carry is None:
+            return None
+        from sheeprl_tpu.serve.server import encode_array
+
+        leaves = [np.ascontiguousarray(np.asarray(c)) for c in carry]
+        return {
+            "session": session,
+            "algo": self.player.algo,
+            "generation": self.store.generation,
+            "carry": [encode_array(leaf, packed=True) for leaf in leaves],
+            "crc": _carry_crc(leaves),
+        }
+
+    def restore_session_carry(self, session: str, snapshot: Dict[str, Any]) -> None:
+        """Install a :meth:`get_session_carry` snapshot as ``session``'s
+        carry, validating algo, leaf shapes/dtypes against ``carry_spec``
+        and the CRC stamp.  Raises ValueError on any mismatch — a failed
+        restore must surface to the router, never silently seed a session
+        with a zero or corrupt carry."""
+        if not self.player.stateful:
+            raise ValueError(f"player '{self.player.algo}' is stateless: no carry to restore")
+        algo = snapshot.get("algo")
+        if algo not in (None, self.player.algo):
+            raise ValueError(f"carry snapshot is for algo '{algo}', not '{self.player.algo}'")
+        from sheeprl_tpu.serve.server import decode_array
+
+        spec = self.player.carry_spec
+        raw = snapshot.get("carry")
+        if not isinstance(raw, (list, tuple)) or len(raw) != len(spec):
+            got = len(raw) if isinstance(raw, (list, tuple)) else type(raw).__name__
+            raise ValueError(f"carry snapshot has {got} leaves, expected {len(spec)}")
+        leaves = []
+        for i, (value, (shape, dtype)) in enumerate(zip(raw, spec)):
+            leaf = np.ascontiguousarray(decode_array(value))
+            want = (1, *shape)
+            if leaf.shape != want or leaf.dtype != np.dtype(dtype):
+                raise ValueError(
+                    f"carry leaf {i} is {leaf.shape}/{leaf.dtype}, "
+                    f"expected {want}/{dtype}"
+                )
+            leaves.append(leaf)
+        stamp = snapshot.get("crc")
+        if stamp is None or int(stamp) != _carry_crc(leaves):
+            raise ValueError("carry snapshot failed its CRC check (torn or corrupted mirror)")
+        with self._sessions_lock:
+            self._sessions[session] = tuple(leaves)
+
     # -- dispatch ------------------------------------------------------------
     def _next_seed(self) -> int:
         with self._seed_lock:
@@ -333,6 +395,19 @@ class PolicyService:
                 out[f"Serve/{key}"] = float(value)
         out["Serve/degraded"] = 1.0 if s.get("degraded") else 0.0
         return out
+
+
+def _carry_crc(leaves: Sequence[np.ndarray]) -> int:
+    """CRC32 over every carry leaf's shape/dtype header + raw C-order
+    bytes — the integrity stamp on migrated session carries."""
+    import zlib
+
+    crc = 0
+    for leaf in leaves:
+        header = f"{leaf.shape}:{leaf.dtype}".encode()
+        crc = zlib.crc32(header, crc)
+        crc = zlib.crc32(np.ascontiguousarray(leaf).tobytes(), crc)
+    return crc & 0xFFFFFFFF
 
 
 def _session_waves(batch: List[_Request]) -> List[List[_Request]]:
