@@ -1111,6 +1111,149 @@ def bench_sebulba() -> dict:
     }
 
 
+def bench_dcn() -> dict:
+    """Cross-host (fake-DCN) pod transport benchmark (``--mode dcn``,
+    ISSUE 19).
+
+    Two measured phases over a REAL 2-process pod (``SHEEPRL_FAKE_DCN``
+    learner + actor cells; segments and params cross the process boundary
+    over the learner front's HTTP transport):
+
+    * **throughput** — a fresh ppo_decoupled pod run to
+      ``BENCH_DCN_STEPS``; rank 0's ``POD_STATS_JSON`` line yields the
+      DCN counters: param-broadcast publishes/bytes, segment intake
+      rate/bytes, push retries/waits, staleness ledgers;
+    * **restart** — the same pod relaunched with a raised step budget and
+      ``checkpoint.resume_from=auto`` (exactly what the pod supervisor
+      appends after a preemption); the bench times spawn → first NEW
+      committed snapshot: the end-to-end pod recovery latency (init +
+      coordinated resume + warmup + first window + all-rank commit).
+
+    GATES the never-drop contract across the DCN: every segment the actor
+    cell ever enqueued was accepted by the learner front
+    (``queue_total_put == segments_accepted``) with zero rejects in a
+    clean run.
+    """
+    import glob as _glob
+    import shutil
+    import subprocess
+    import sys as _sys
+
+    steps = int(os.environ.get("BENCH_DCN_STEPS", 64))
+    hosts = max(2, int(os.environ.get("BENCH_DCN_HOSTS", 2)))
+    log_dir = "/tmp/bench_dcn"
+    shutil.rmtree(log_dir, ignore_errors=True)
+
+    common = [
+        "exp=ppo_decoupled",
+        "env=dummy",
+        "env.id=discrete_dummy",
+        "env.max_episode_steps=16",
+        "env.num_envs=2",
+        "env.sync_env=True",
+        "env.capture_video=False",
+        "topology=pod",
+        "topology.env_workers=2",
+        "fabric.devices=auto",
+        "fabric.accelerator=cpu",
+        "algo.rollout_steps=4",
+        "algo.per_rank_batch_size=8",
+        "algo.update_epochs=1",
+        "algo.mlp_keys.encoder=[state]",
+        "algo.run_test=False",
+        "checkpoint.every=16",
+        "checkpoint.save_last=False",
+        "checkpoint.commit_timeout_s=30",
+        "buffer.memmap=False",
+        "metric.log_level=1",
+        "metric.log_every=1",
+        f"log_dir={log_dir}",
+        "print_config=False",
+    ]
+
+    def run_pod(extra: list, timeout_s: float = 420.0) -> tuple:
+        env = dict(os.environ)
+        env.update({"SHEEPRL_FAKE_DCN": str(hosts), "JAX_PLATFORMS": "cpu"})
+        env.pop("BENCH_CHILD", None)
+        proc = subprocess.Popen(
+            [_sys.executable, "-m", "sheeprl_tpu", *common, *extra],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        t0 = time.perf_counter()
+        first_commit_s = None
+        existing = set(_glob.glob(os.path.join(log_dir, "**", "COMMIT"), recursive=True))
+        stats = None
+        # line-by-line so the commit watch has real-time resolution
+        deadline = time.monotonic() + timeout_s
+        for line in proc.stdout:  # type: ignore[union-attr]
+            if "POD_STATS_JSON=" in line:
+                stats = json.loads(line.split("POD_STATS_JSON=", 1)[1])
+            if first_commit_s is None:
+                fresh = (
+                    set(_glob.glob(os.path.join(log_dir, "**", "COMMIT"), recursive=True))
+                    - existing
+                )
+                if fresh:
+                    first_commit_s = time.perf_counter() - t0
+            if time.monotonic() > deadline:
+                proc.kill()
+                break
+        rc = proc.wait(timeout=60)
+        if rc != 0 or stats is None:
+            raise RuntimeError(f"bench_dcn pod run failed (rc={rc}, stats={stats is not None})")
+        return stats, first_commit_s, time.perf_counter() - t0
+
+    # ---- phase 1: clean-run DCN throughput --------------------------------
+    stats, _, wall = run_pod([f"algo.total_steps={steps}"])
+    dcn = stats.get("dcn", {})
+    drop = stats.get("zero_drop", {})
+    accepted = int(drop.get("segments_accepted", 0))
+    rejected = int(drop.get("segments_rejected", 0))
+    total_put = int(drop.get("queue_total_put", -1))
+    zero_drop_ok = accepted == total_put and rejected == 0 and accepted > 0
+    seg_bytes = float(dcn.get("Dcn/segment_bytes", 0.0))
+    bc_bytes = float(dcn.get("Dcn/broadcast_bytes", 0.0))
+    bc_pubs = max(int(dcn.get("Dcn/broadcast_publishes", 0)), 1)
+
+    # ---- phase 2: restart-to-first-update (the preemption recovery path) --
+    _, first_commit_s, _ = run_pod(
+        [f"algo.total_steps={steps + 32}", "checkpoint.resume_from=auto"]
+    )
+
+    return {
+        "metric": (
+            f"dcn_segments_per_s (ppo_decoupled pod, {hosts} fake hosts, "
+            f"{steps} steps, cpu)"
+        ),
+        "value": round(accepted / wall, 2),
+        "unit": "segments/s",
+        "env_steps_per_s": round(stats.get("env_steps_per_s", 0.0), 2),
+        "updates_per_s": round(stats.get("updates_per_s", 0.0), 3),
+        "traj_mib_per_s": round(seg_bytes / wall / 2**20, 4),
+        "broadcast_publishes": int(dcn.get("Dcn/broadcast_publishes", 0)),
+        "broadcast_kib_per_publish": round(bc_bytes / bc_pubs / 1024, 1),
+        "push_retries": int(dcn.get("rank1/Dcn/push_retries", 0)),
+        "backpressured": int(dcn.get("Dcn/backpressured", 0)),
+        "param_staleness_max": stats.get("param_staleness_max", 0),
+        "traj_staleness_max": stats.get("traj_staleness_max", 0),
+        "torn_rejected": stats.get("torn_rejected", 0),
+        # pod recovery latency: relaunch with resume_from=auto (what the
+        # pod supervisor does after a preemption) -> first NEW all-rank
+        # commit.  None means the resumed run never committed in time.
+        "restart_to_first_commit_s": (
+            round(first_commit_s, 2) if first_commit_s is not None else None
+        ),
+        # the never-drop contract, measured across a real process boundary
+        "zero_drop": {
+            "queue_total_put": total_put,
+            "segments_accepted": accepted,
+            "segments_rejected": rejected,
+        },
+        "zero_drop_ok": zero_drop_ok,
+        "gate_failed": not zero_drop_ok or first_commit_s is None,
+    }
+
+
 def bench_pipeline() -> dict:
     """MPMD pipeline-parallel world-model update bench (``--mode pipeline``,
     ISSUE 16).
@@ -1761,6 +1904,8 @@ def _run_bench() -> dict:
         return bench_env()
     if target == "sebulba":
         return bench_sebulba()
+    if target == "dcn":
+        return bench_dcn()
     if target == "pipeline":
         return bench_pipeline()
     if target in BASELINE_CPU_WALL_CLOCK_S:

@@ -47,7 +47,14 @@ def main(fabric: Any, cfg: Any) -> None:
 
     from sheeprl_tpu.parallel.topology import resolve_topology
 
-    if resolve_topology(cfg, fabric) == "sebulba":
+    topo_name = resolve_topology(cfg, fabric)
+    if topo_name == "pod":
+        # the cross-host actor/learner split (docs/distributed.md)
+        from sheeprl_tpu.sebulba.pod import run_pod
+
+        run_pod(fabric, cfg)
+        return
+    if topo_name == "sebulba":
         # the Sebulba actor/learner device split (docs/sebulba.md)
         from sheeprl_tpu.sebulba.sac import run_sebulba
 

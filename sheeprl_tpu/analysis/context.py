@@ -41,6 +41,7 @@ METRIC_FAMILIES: Tuple[str, ...] = (
     "Serve",       # policy-as-a-service stats
     "Fleet",       # serving-fleet router (replicas, failovers, migrations)
     "Sebulba",     # actor-learner topology queues/broadcast
+    "Dcn",         # cross-host pod transport (segments, broadcast, control)
     "Player",      # PlayerSync staleness
     "Telemetry",   # introspection endpoint self-metrics
 )

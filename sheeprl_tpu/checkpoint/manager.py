@@ -33,9 +33,11 @@ from sheeprl_tpu.checkpoint.preemption import PREEMPTION_GUARD
 from sheeprl_tpu.checkpoint.protocol import (
     gc_checkpoints,
     latest_checkpoint,
+    probe_shared_root,
     step_dir_name,
     write_commit,
     write_shard,
+    write_shared_root_probe,
 )
 from sheeprl_tpu.checkpoint.serialize import snapshot_tree, to_host_tree
 from sheeprl_tpu.checkpoint.writer import AsyncCheckpointWriter
@@ -64,6 +66,19 @@ class CheckpointManager:
         self._finalized = False
         self._iter = 0
         self._agreed_preempt = False
+        # lockstep=False (the pod topology): ranks do NOT call should_save /
+        # save in the same iteration, so the collective preemption poll and
+        # the post-save barrier are off — agreement arrives over the pod
+        # control plane via force_preempt() instead
+        self.lockstep = True
+        self._probed_shared_root = False
+        if fabric.num_processes > 1 and fabric.global_rank == 0:
+            # rank 0 drops the shared-root probe marker NOW so rank >0's
+            # first save can fail fast when checkpoint.root is host-local
+            try:
+                write_shared_root_probe(self.root)
+            except OSError:
+                pass  # surfaced properly by the first real save
 
     # -- cadence -------------------------------------------------------------
     @property
@@ -79,9 +94,16 @@ class CheckpointManager:
         """
         if self._agreed_preempt:
             return True
-        if self.fabric.num_processes <= 1 and self._guard.requested():
+        if (self.fabric.num_processes <= 1 or not self.lockstep) and self._guard.requested():
             self._agreed_preempt = True
         return self._agreed_preempt
+
+    def force_preempt(self) -> None:
+        """Adopt a preemption decided OUTSIDE the collective poll — the pod
+        control plane (an actor cell's latch surfaced by its ``/poll``)
+        calls this so the learner enters the same final committed save the
+        in-process latch would trigger."""
+        self._agreed_preempt = True
 
     def _poll_preemption(self) -> bool:
         """Latch preemption IN AGREEMENT across ranks: every
@@ -92,7 +114,7 @@ class CheckpointManager:
         synchronous save at the same step, and the commit completes."""
         if self._agreed_preempt:
             return True
-        if self.fabric.num_processes <= 1:
+        if self.fabric.num_processes <= 1 or not self.lockstep:
             return self.preempted
         if self._iter % self.preemption_poll_every == 0:
             flags = self.fabric.all_gather_object(bool(self._guard.requested()))
@@ -144,6 +166,11 @@ class CheckpointManager:
         def job() -> int:
             from sheeprl_tpu.utils.utils import device_sync
 
+            if world > 1 and rank > 0 and not self._probed_shared_root:
+                # fail fast with the shared-storage error instead of rank
+                # 0's bare wait_for_shards timeout minutes later
+                probe_shared_root(self.root, rank, timeout_s=60.0)
+                self._probed_shared_root = True
             # true completion fence before the host fetch (PR-1 semantics:
             # block_until_ready resolves at dispatch on the axon tunnel)
             device_sync(snap)
@@ -171,8 +198,11 @@ class CheckpointManager:
                 seconds=time.perf_counter() - t0, nbytes=nbytes, asynchronous=False
             )
             # all ranks leave the save together so no rank races ahead into
-            # teardown while rank 0 still waits on its shards
-            self.fabric.barrier()
+            # teardown while rank 0 still waits on its shards (lockstep
+            # loops only: pod cells are not in the same iteration, and the
+            # commit wait itself is the learner's ordering fence)
+            if self.lockstep:
+                self.fabric.barrier()
         else:
             if self._writer is None:
                 self._writer = AsyncCheckpointWriter(

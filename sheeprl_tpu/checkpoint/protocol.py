@@ -45,6 +45,10 @@ from sheeprl_tpu.checkpoint.serialize import (
 COMMIT_FILE = "COMMIT"
 MANIFEST_FILE = "MANIFEST.json"
 STEP_PREFIX = "step_"
+SHARED_ROOT_PROBE = ".shared_root_probe"
+SHARED_ROOT_ERROR = (
+    "checkpoint.root must be shared storage (GCS/NFS) for multi-host runs"
+)
 
 
 def step_dir_name(step: int) -> str:
@@ -57,6 +61,48 @@ def shard_name(rank: int) -> str:
 
 def _meta_name(rank: int) -> str:
     return f"shard_r{int(rank):05d}.meta.json"
+
+
+def _shard_rank(name: str) -> Optional[int]:
+    """Rank encoded in a shard file name (None if not a shard name)."""
+    if name.startswith("shard_r") and name.endswith(".pkl"):
+        try:
+            return int(name[len("shard_r"):-len(".pkl")])
+        except ValueError:
+            return None
+    return None
+
+
+def write_shared_root_probe(root: Union[str, os.PathLike]) -> Path:
+    """Rank 0's half of the shared-filesystem validation: durably drop a
+    probe marker at the checkpoint root.  Cheap and idempotent — called at
+    manager/pod startup, long before the first shard write."""
+    root = Path(root)
+    root.mkdir(parents=True, exist_ok=True)
+    probe = root / SHARED_ROOT_PROBE
+    durable_write(probe, json.dumps({"time": time.time(), "pid": os.getpid()}).encode())
+    return probe
+
+
+def probe_shared_root(
+    root: Union[str, os.PathLike], rank: int, timeout_s: float = 60.0
+) -> None:
+    """Rank >0's half: fail FAST and CLEARLY when ``root`` is not shared
+    storage.  Without this, a per-host local ``checkpoint.root`` surfaces
+    only as rank 0's bare ``wait_for_shards`` timeout minutes later (rank
+    >0's shards land on a disk rank 0 can never see)."""
+    if int(rank) == 0:
+        return
+    probe = Path(root) / SHARED_ROOT_PROBE
+    deadline = time.monotonic() + float(timeout_s)
+    while not probe.exists():
+        if time.monotonic() >= deadline:
+            raise RuntimeError(
+                f"{SHARED_ROOT_ERROR}: rank {rank} waited {timeout_s:g}s at "
+                f"{Path(root)} for rank 0's probe marker and it never appeared "
+                "(each host is writing to its own private directory)"
+            )
+        time.sleep(0.1)
 
 
 def checkpoint_step(step_dir: Union[str, os.PathLike]) -> int:
@@ -102,6 +148,17 @@ def wait_for_shards(
         if not missing:
             break
         if time.monotonic() >= deadline:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "checkpoint: %s still missing shards from ranks %s after %gs "
+                "(snapshot stays uncommitted). If those ranks run on other "
+                "hosts, check that %s",
+                step_dir.name,
+                missing,
+                timeout_s,
+                SHARED_ROOT_ERROR,
+            )
             return None
         time.sleep(0.05)
     shards: Dict[str, Dict[str, int]] = {}
@@ -164,16 +221,27 @@ def verify_checkpoint(step_dir: Union[str, os.PathLike]) -> List[str]:
         manifest = read_manifest(step_dir)
     except (OSError, json.JSONDecodeError) as e:
         return [f"{step_dir}: unreadable manifest ({e})"]
-    for name, meta in manifest.get("shards", {}).items():
+    shards = manifest.get("shards", {})
+    world = int(manifest.get("world", len(shards)) or len(shards))
+    listed = {_shard_rank(n) for n in shards}
+    unlisted = [r for r in range(world) if r not in listed]
+    if unlisted:
+        problems.append(
+            f"manifest world={world} but shards for ranks {unlisted} are not "
+            "listed (commit raced a partial shard set?)"
+        )
+    for name, meta in shards.items():
         shard = step_dir / name
+        rank = _shard_rank(name)
+        tag = f"{name} (rank {rank})" if rank is not None else name
         if not shard.exists():
-            problems.append(f"{name}: missing")
+            problems.append(f"{tag}: missing")
             continue
         data = shard.read_bytes()
         if len(data) != meta["bytes"]:
-            problems.append(f"{name}: {len(data)} bytes, manifest says {meta['bytes']}")
+            problems.append(f"{tag}: {len(data)} bytes, manifest says {meta['bytes']}")
         elif (zlib.crc32(data) & 0xFFFFFFFF) != meta["crc32"]:
-            problems.append(f"{name}: CRC mismatch")
+            problems.append(f"{tag}: CRC mismatch")
     return problems
 
 

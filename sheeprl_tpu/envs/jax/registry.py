@@ -101,12 +101,14 @@ def anakin_enabled(cfg: Any, fabric: Any) -> bool:
     else:
         return False
     if wanted and fabric.num_processes > 1:
-        import warnings
+        from sheeprl_tpu.parallel.distributed import rank_zero_warn
 
-        warnings.warn(
+        # once, on rank 0 — N processes each printing the same fallback
+        # turns a pod launch into a wall of duplicate warnings
+        rank_zero_warn(
             "algo.anakin: multi-process run — falling back to the vector-env "
             "adapter path (fused rollouts are single-process for now)",
-            RuntimeWarning,
+            key="anakin.multiprocess_fallback",
         )
         return False
     return wanted

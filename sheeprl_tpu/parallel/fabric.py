@@ -589,7 +589,13 @@ class Fabric:
             return None
         from jax._src import distributed
 
-        return distributed.global_state.client
+        if distributed.global_state.client is None:
+            return None
+        # the thread-safe wrapper: raw client calls from two threads (the
+        # PeerWatchdog beating during a host collective) segfault
+        from sheeprl_tpu.parallel.distributed import _SafeKV
+
+        return _SafeKV(distributed.global_state.client)
 
     @staticmethod
     def _kv_timeout_ms() -> int:
@@ -929,6 +935,12 @@ def build_fabric(cfg: Any) -> Fabric:
     """Instantiate the runtime from ``cfg.fabric`` (+ register callbacks)."""
     global _TP_MIN_PARAM_SIZE_WARNED
     fab_cfg = cfg.fabric
+    # distributed init FIRST: jax.distributed.initialize must run before
+    # the first backend touch (Fabric.__init__ calls jax.devices()), or the
+    # process binds a single-host backend and can never join the pod
+    from sheeprl_tpu.parallel.distributed import ensure_distributed
+
+    ensure_distributed(cfg)
     cache_dir = fab_cfg.get("compilation_cache_dir")
     if cache_dir:
         # persistent XLA compilation cache: the 20-40s first compile of a
@@ -954,17 +966,19 @@ def build_fabric(cfg: Any) -> Fabric:
         # player clones) and a per-call DeprecationWarning floods the log —
         # and "default"-filtered warnings dedupe per call SITE, which this
         # single callsite defeats.  Pinned by
-        # tests/test_sharding/test_deprecation.py.
-        import warnings
+        # tests/test_sharding/test_deprecation.py.  In a pod, only rank 0
+        # speaks: the knob is global config, so N hosts repeating the same
+        # deprecation is noise (rank_zero_warn also latches per-process).
+        from sheeprl_tpu.parallel.distributed import rank_zero_warn
 
         _TP_MIN_PARAM_SIZE_WARNED = True
-        warnings.warn(
+        rank_zero_warn(
             "fabric.tp_min_param_size is deprecated: parameter placement is "
             "now decided by the sharding rules engine (sharding.rules / "
             "sharding.table, see docs/sharding.md). The knob still "
             "parameterizes the legacy 'size_threshold' fallback table only.",
             DeprecationWarning,
-            stacklevel=2,
+            key="fabric.tp_min_param_size",
         )
     # the sharding config group travels with the algo name so `table: auto`
     # can resolve the curated per-algo rule table at first use
@@ -981,6 +995,8 @@ def build_fabric(cfg: Any) -> Fabric:
         tp_min_param_size=fab_cfg.get("tp_min_param_size", 2**18),
         sharding=sharding_cfg,
     )
+    if fabric.num_processes > 1:
+        _validate_pod_device_view(fabric)
     cb_cfg = fab_cfg.get("callbacks", {}) or {}
     if "checkpoint" in cb_cfg:
         from sheeprl_tpu.utils.callback import CheckpointCallback
@@ -992,6 +1008,34 @@ def build_fabric(cfg: Any) -> Fabric:
     # the default signal disposition — latching a signal nobody reads would
     # swallow the preemption grace window entirely
     return fabric
+
+
+def _validate_pod_device_view(fabric: Fabric) -> None:
+    """Multi-process sanity of the per-process device view.
+
+    Hard requirements: this process must SEE the whole pod (a process
+    whose ``jax.devices()`` is local-only never initialized the
+    distributed backend) and must own at least one local device.  Soft
+    requirement (warned, rank 0 only): the mesh should cover every
+    process — a mesh that excludes a rank's devices is legal for
+    host-collective-only fabrics but no pod topology can train on it.
+    """
+    from sheeprl_tpu.parallel.distributed import rank_zero_warn
+
+    procs_seen = {d.process_index for d in jax.devices(fabric.accelerator)}
+    if len(procs_seen) < fabric.num_processes:
+        raise RuntimeError(
+            f"fabric.distributed: jax reports {fabric.num_processes} processes but this "
+            f"rank's device view covers only processes {sorted(procs_seen)} — "
+            "distributed init ran after a backend touch, or the pod is partitioned"
+        )
+    mesh_procs = {d.process_index for d in fabric.mesh.devices.flat}
+    if len(mesh_procs) < fabric.num_processes:
+        rank_zero_warn(
+            f"fabric.devices={len(fabric.devices)} leaves some processes with no mesh "
+            "devices; pod topologies need fabric.devices=auto (the global mesh)",
+            key="fabric.pod_device_view",
+        )
 
 
 def trainer_device_count(fabric: Fabric, player_process: int = 0) -> int:

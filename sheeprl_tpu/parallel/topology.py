@@ -52,31 +52,51 @@ def topology_cfg(cfg: Any) -> Dict[str, Any]:
 
 
 def resolve_topology(cfg: Any, fabric: Fabric) -> str:
-    """Which decoupled topology this run should use: ``"sebulba"`` or
-    ``"pipelined"``.
+    """Which decoupled topology this run should use: ``"sebulba"``,
+    ``"pod"`` (cross-host sebulba) or ``"pipelined"``.
 
     ``auto`` (the default) upgrades to sebulba only when the user sized the
     device split (``topology.actor_devices`` set): the pipelined
     single-controller loop *is* the degenerate sebulba (both roles
     time-share every device), and silently re-topologizing existing runs
     would change their compile set and overlap semantics.  ``sebulba``
-    forces the split and raises where it cannot exist (multi-process, or a
-    tensor-parallel ``model`` mesh axis — the learner sub-mesh is 1-D).
+    forces the split and raises where it cannot exist (a tensor-parallel
+    ``model`` mesh axis — the learner sub-mesh is 1-D).
+
+    Multi-process runs dispatch to the **pod** flavor: the process
+    boundary IS the device split (one learner cell, N-1 actor cells on
+    different hosts; see :class:`PodTopology` and ``sheeprl_tpu.sebulba.
+    pod``), so a wanted split no longer refuses ``fabric.num_processes >
+    1`` — it crosses the DCN instead.
     """
     topo = topology_cfg(cfg)
     name = str(topo.get("name", "auto")).lower()
     if name == "pipelined":
         return "pipelined"
-    if name not in ("auto", "sebulba"):
+    if name not in ("auto", "sebulba", "pod"):
         raise ValueError(
-            f"topology.name must be auto|pipelined|sebulba, got {name!r}"
+            f"topology.name must be auto|pipelined|sebulba|pod, got {name!r}"
         )
-    wanted = name == "sebulba" or topo.get("actor_devices") is not None
+    if name == "pod" and fabric.num_processes <= 1:
+        raise ValueError(
+            "topology=pod needs a multi-process fabric (fabric.distributed.*, "
+            "or SHEEPRL_FAKE_DCN=N for the CI pod)"
+        )
+    wanted = name in ("sebulba", "pod") or topo.get("actor_devices") is not None
     if not wanted:
+        if fabric.num_processes > 1:
+            from sheeprl_tpu.parallel.distributed import rank_zero_warn
+
+            rank_zero_warn(
+                "multi-process fabric without a topology split: the "
+                "pipelined loop will run in lockstep collectives only "
+                "(set topology=pod for the cross-host actor/learner split)",
+                key="topology.pod_hint",
+            )
         return "pipelined"
-    reasons = []
     if fabric.num_processes > 1:
-        reasons.append("multi-process runs (the split is single-controller)")
+        return "pod"
+    reasons = []
     if fabric.model_axis is not None:
         reasons.append("a tensor-parallel 'model' mesh axis")
     if reasons:
@@ -192,6 +212,86 @@ class DeviceTopology:
                 RuntimeWarning,
             )
         return cls(fabric, devices[:a], devices[a : a + l])
+
+
+@dataclass
+class PodTopology:
+    """The cross-host actor/learner split: the process boundary IS the
+    device split.
+
+    One process (``topology.pod.learner_process``, fixed at rank 0 — the
+    checkpoint commit protocol's manifest writer) is the **learner cell**;
+    every other process is an **actor cell**.  Each cell computes only on
+    its OWN local devices through a 1-D local fabric — there are no
+    cross-host XLA collectives in the steady-state data path.  Everything
+    that crosses hosts goes over the DCN transport
+    (:mod:`sheeprl_tpu.sebulba.transport`): CRC-stamped trajectory
+    segments in, versioned parameter fetches out, and the control plane
+    (commit steps, preemption, liveness) alongside.
+    """
+
+    fabric: Fabric
+    role: str  # "learner" | "actor"
+    process_index: int
+    learner_process: int
+    actor_cells: List[int]
+    local_devices: List[Any]
+    cell_fabric: Fabric = field(init=False)
+
+    def __post_init__(self) -> None:
+        self.cell_fabric = _submesh_fabric(self.fabric, self.local_devices)
+
+    @property
+    def num_actor_cells(self) -> int:
+        return len(self.actor_cells)
+
+    @property
+    def cell_index(self) -> int:
+        """This actor cell's dense index among the actor cells (learner: -1)."""
+        return self.actor_cells.index(self.process_index) if self.role == "actor" else -1
+
+    def describe(self) -> str:
+        devs = ", ".join(str(d) for d in self.local_devices)
+        return (
+            f"pod topology: {self.fabric.num_processes} cells "
+            f"(learner=process {self.learner_process}, actors={self.actor_cells}); "
+            f"this cell: rank {self.process_index} role={self.role} devices=[{devs}]"
+        )
+
+    @classmethod
+    def from_config(cls, fabric: Fabric, cfg: Any) -> "PodTopology":
+        import jax
+
+        topo = topology_cfg(cfg)
+        pod = dict(topo.get("pod") or {})
+        world = fabric.num_processes
+        if world < 2:
+            raise ValueError("PodTopology needs >= 2 processes (one learner cell + actors)")
+        learner_process = int(pod.get("learner_process", 0) or 0)
+        if learner_process != 0:
+            # rank 0 writes the checkpoint manifest + COMMIT (protocol.py);
+            # splitting the learner from the committer would leave the
+            # commit racing a cell that has no authoritative step counter
+            raise ValueError(
+                "topology.pod.learner_process must be 0 (the checkpoint "
+                f"commit rank), got {learner_process}"
+            )
+        rank = fabric.global_rank
+        local = [d for d in jax.local_devices() if d.platform == fabric.accelerator]
+        if not local:
+            raise RuntimeError(
+                f"pod cell {rank} owns no local {fabric.accelerator} devices — "
+                "fabric.devices must be 'auto' so the global mesh spans every cell"
+            )
+        actor_cells = [r for r in range(world) if r != learner_process]
+        return cls(
+            fabric,
+            role="learner" if rank == learner_process else "actor",
+            process_index=rank,
+            learner_process=learner_process,
+            actor_cells=actor_cells,
+            local_devices=local,
+        )
 
 
 class StalenessExceeded(RuntimeError):

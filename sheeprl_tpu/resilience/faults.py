@@ -57,13 +57,18 @@ KNOWN_SITES = (
     "sebulba.env_worker",
     "sebulba.traj_queue",
     "update.grads",
+    "dcn.broadcast",
+    "dcn.traj",
 )
 
 KINDS = ("raise", "hang", "latency", "corrupt", "truncate", "nonfinite", "divergence")
 
 #: Sites whose hook passes a byte payload (``fault_bytes``) — the only
-#: legal targets for ``corrupt`` specs.
-BYTE_SITES = ("checkpoint.write_shard",)
+#: legal targets for ``corrupt`` specs.  The two ``dcn.*`` sites sit on
+#: the cross-host wire AFTER the CRC stamp: ``corrupt``/``truncate``
+#: there model a damaged DCN payload, which the receiving cell's CRC
+#: check must reject (torn-segment / torn-broadcast contract).
+BYTE_SITES = ("checkpoint.write_shard", "dcn.broadcast", "dcn.traj")
 
 #: Sites whose hook passes replay rows (``fault_rows``): ``truncate`` there
 #: tail-halves the queued rows (a torn spill write / a torn trajectory

@@ -137,6 +137,12 @@ class PPOWorkerProtocol:
 def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     """Train decoupled PPO through the Sebulba topology.  Returns a stats
     dict (throughput/queue/staleness counters) for ``bench.py``."""
+    if fabric.num_processes > 1:
+        # multi-process runs split actors and learner across HOSTS, not
+        # devices: the in-process topology below assumes one device view
+        from sheeprl_tpu.sebulba.pod import run_pod
+
+        return run_pod(fabric, cfg)
     from sheeprl_tpu.envs.jax.registry import is_jax_native
 
     topo_cfg = topology_cfg(cfg)

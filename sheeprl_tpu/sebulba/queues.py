@@ -140,6 +140,13 @@ class TrajQueue:
     Segment metadata travels alongside the payload: the param version the
     segment was collected with (staleness accounting), its worker id, and
     its env-step count (throughput accounting).
+
+    In the pod topology the same queue (and the same contract) sits at
+    BOTH ends of the DCN: each actor cell buffers its workers' segments in
+    a host-side queue (``stage=False``) drained by the transport pusher,
+    and the learner front feeds its staged queue from CRC-verified HTTP
+    intake (``sebulba/transport.py``) — torn segments are rejected at the
+    wire with the exact :class:`TornTrajectory` semantics used in-process.
     """
 
     def __init__(
@@ -314,4 +321,7 @@ class TrajQueue:
                 "Sebulba/queue_put_wait_s": float(self.put_wait_s),
                 "Sebulba/queue_get_wait_s": float(self.get_wait_s),
                 "Sebulba/queue_torn_rejected": float(self.torn_rejected),
+                # accepted-segment count: the pod zero-drop gate compares
+                # this against the transport's pushed/accepted counters
+                "Sebulba/queue_total_put": float(self.total_put),
             }

@@ -79,15 +79,21 @@ def build_worker_fleet(
     log_dir: str,
     stop_event: threading.Event,
     stats_sink: Callable,
+    env_offset: int = 0,
 ) -> WorkerSupervisor:
     """The env-worker fleet both drivers spawn: worker ``i`` owns env slice
     ``[i*envs_per_worker, (i+1)*envs_per_worker)`` built through the
     standard ``make_env``/``vectorize`` machinery; a respawn (bumped
     generation) reseeds the slice so the fresh worker's streams diverge
-    from the deposed one's."""
+    from the deposed one's.
+
+    ``env_offset`` shifts the whole fleet's slice within a LARGER global
+    env space: a pod actor cell owns ``[offset, offset + num_workers *
+    envs_per_worker)`` of the pod-wide ``env.num_envs``, so seeds and
+    ``vector_env_idx`` stay globally unique across cells."""
 
     def spawn(worker_id: int, generation: int) -> EnvWorker:
-        base = worker_id * envs_per_worker
+        base = env_offset + worker_id * envs_per_worker
         seed = cfg.seed + base + 100003 * generation
 
         def env_builder(_seed=seed, _base=base):

@@ -145,6 +145,12 @@ class SACWorkerProtocol:
 def run_sebulba(fabric: Any, cfg: Any) -> Dict[str, Any]:
     """Train decoupled SAC through the Sebulba topology.  Returns a stats
     dict (throughput/queue/staleness counters) for ``bench.py``."""
+    if fabric.num_processes > 1:
+        # multi-process runs split actors and learner across HOSTS, not
+        # devices: the in-process topology below assumes one device view
+        from sheeprl_tpu.sebulba.pod import run_pod
+
+        return run_pod(fabric, cfg)
     topo_cfg = topology_cfg(cfg)
     topo = DeviceTopology.from_config(fabric, cfg)
     learner_fab = topo.learner_fabric

@@ -1,0 +1,710 @@
+"""The pod's DCN transport: cross-host trajectory intake + param serving.
+
+Two endpoints of one contract (docs/distributed.md):
+
+* :class:`LearnerFront` — the HTTP server the learner cell owns.  It is
+  the cross-host face of the two Sebulba primitives: trajectory segments
+  POSTed by remote actor cells flow — CRC-verified — into the learner's
+  ordinary :class:`~sheeprl_tpu.sebulba.queues.TrajQueue` (same
+  never-drop/torn-segment-reject contract as the in-process path), and
+  fresh params are GET-served with the same versioned ``max_staleness``
+  gate :class:`~sheeprl_tpu.parallel.topology.ParamBroadcast` enforces
+  in-process (:class:`DcnParamBroadcast` below literally *is* a
+  ParamBroadcast whose publish side serializes instead of device-copies).
+  A ``/poll`` control plane rides along: commit-step announcements,
+  coordinated preemption, per-cell telemetry snapshots (rank-0
+  aggregation), and liveness (an actor cell silent past
+  ``heartbeat_grace_s`` raises :class:`~sheeprl_tpu.parallel.distributed.
+  PeerLost` into the learner loop).
+
+* :class:`PodClient` — the actor cell's side.  ``push_segment`` retries
+  backpressure (503) and torn rejects (409) until ``push_deadline_s``
+  — never drops; ``fetch_params`` verifies the CRC before unpickling (a
+  damaged broadcast is refetched, never applied); ``poll`` reports the
+  applied param version, the local preemption latch and a telemetry
+  snapshot, and returns the learner's control word.
+
+Fault sites: ``dcn.traj`` (the segment payload on the wire, per push
+attempt) and ``dcn.broadcast`` (the param payload, per fetch) — both
+byte sites stamped AFTER the CRC, so injected corruption/truncation is
+exactly what the receiving side's CRC check must catch.
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from sheeprl_tpu.parallel.distributed import PeerLost, is_fake_dcn
+from sheeprl_tpu.parallel.topology import ParamBroadcast
+from sheeprl_tpu.resilience.faults import fault_bytes
+from sheeprl_tpu.sebulba.queues import TornTrajectory, TrajQueue
+from sheeprl_tpu.serve.batcher import QueueFull, ServiceStopped
+
+_KV_FRONT_KEY = "sheeprl_tpu/dcn/front"
+
+
+class SegmentPushError(RuntimeError):
+    """A segment could not be delivered within ``push_deadline_s`` — the
+    never-drop contract fails LOUDLY, it does not discard."""
+
+
+def _crc(payload: bytes) -> int:
+    return zlib.crc32(payload) & 0xFFFFFFFF
+
+
+def advertise_host() -> str:
+    """The address remote cells can reach this host at (loopback for the
+    fake-DCN pod, the hostname's address for real multi-host pods)."""
+    if is_fake_dcn():
+        return "127.0.0.1"
+    try:
+        return socket.gethostbyname(socket.gethostname())
+    except OSError:
+        return socket.getfqdn()
+
+
+def publish_front_address(address: str) -> None:
+    """Advertise the learner front's address through the jax.distributed
+    KV store so actor cells need no address config at all."""
+    from sheeprl_tpu.parallel.distributed import _kv_client
+
+    _kv_client().key_value_set_bytes(_KV_FRONT_KEY, address.encode())
+
+
+def lookup_front_address(timeout_s: float = 120.0) -> str:
+    from sheeprl_tpu.parallel.distributed import _kv_client
+
+    raw = _kv_client().blocking_key_value_get_bytes(_KV_FRONT_KEY, int(timeout_s * 1000))
+    return raw.decode()
+
+
+class DcnParamBroadcast(ParamBroadcast):
+    """ParamBroadcast's cross-DCN flavor: same versioned ``max_staleness``
+    gate, serialized transport.
+
+    ``publish`` pickles the (actor subtree of the) host params ONCE and
+    stamps the CRC; remote fetches are served from that buffer.  The fetch
+    cursors that feed the inherited :meth:`~ParamBroadcast.gate` advance
+    on :meth:`note_applied` — when an actor cell's ``/poll`` reports the
+    version it has actually installed — not at serve time, so a fetch
+    lost on the wire (or rejected by the client's CRC check) cannot
+    satisfy the staleness gate.
+    """
+
+    def __init__(
+        self,
+        actor_ranks: List[int],
+        extract: Callable[[Any], Any] = lambda p: p,
+        max_staleness: int = 2,
+        gate_timeout_s: float = 300.0,
+    ):
+        # the parent's fabric/device plumbing is unused: publish/fetch are
+        # overridden to move bytes, and the gate logic is device-free
+        super().__init__(
+            fabric=None,
+            actor_devices=list(actor_ranks),
+            extract=extract,
+            max_staleness=max_staleness,
+            gate_timeout_s=gate_timeout_s,
+        )
+        self.actor_ranks = list(actor_ranks)
+        self._payload: Optional[bytes] = None
+        self._payload_crc = 0
+        self.bytes_published = 0
+
+    def publish(self, params: Any, version: Optional[int] = None) -> int:
+        from sheeprl_tpu.telemetry.spans import span
+
+        with span("param.broadcast"):
+            payload = pickle.dumps(self.extract(params), protocol=pickle.HIGHEST_PROTOCOL)
+            crc = _crc(payload)
+        with self._lock:
+            first = self.publishes == 0
+            self._version = int(version) if version is not None else self._version + 1
+            if first:
+                self._fetched_version = [self._version] * len(self.actor_ranks)
+            self._payload = payload
+            self._payload_crc = crc
+            self.publishes += 1
+            self.bytes_published += len(payload)
+            self._fetched.notify_all()
+            return self._version
+
+    def payload_for(self, have_version: int) -> Optional[Tuple[bytes, int, int]]:
+        """``(payload, crc, version)`` when newer than ``have_version``
+        (else None).  Serving does NOT advance the gate cursors."""
+        with self._lock:
+            if self._payload is None or self._version <= int(have_version):
+                return None
+            return self._payload, self._payload_crc, self._version
+
+    def note_applied(self, rank: int, version: int) -> None:
+        """An actor cell reported (via ``/poll``) the version it runs."""
+        try:
+            idx = self.actor_ranks.index(int(rank))
+        except ValueError:
+            return
+        with self._lock:
+            lag = self._version - int(version)
+            if int(version) > self._fetched_version[idx]:
+                self._fetched_version[idx] = int(version)
+            self.fetches += 1
+            self.staleness_sum += max(lag, 0)
+            self.staleness_max = max(self.staleness_max, lag)
+            self._fetched.notify_all()
+
+    def fetch(self, actor_index: int) -> tuple:  # pragma: no cover - guard
+        raise NotImplementedError(
+            "DcnParamBroadcast is fetched over HTTP (PodClient.fetch_params)"
+        )
+
+    def metrics(self) -> Dict[str, float]:
+        out = super().metrics()
+        with self._lock:
+            out["Dcn/broadcast_bytes"] = float(self.bytes_published)
+            out["Dcn/broadcast_publishes"] = float(self.publishes)
+        return out
+
+
+class LearnerFront:
+    """The learner cell's DCN server: segment intake, param serving, and
+    the pod control plane, on one ``ThreadingHTTPServer``.
+
+    Exposes ``.error`` exactly like an actor engine so the learner's
+    ordinary :func:`~sheeprl_tpu.sebulba.runner.drain_segments` loop
+    surfaces transport/liveness failures: a peer silent past
+    ``heartbeat_grace_s`` (after first contact; ``first_contact_grace_s``
+    covers the remote cells' compile time) sets ``.error`` to
+    :class:`PeerLost` and the next drain slice raises it.
+    """
+
+    def __init__(
+        self,
+        traj_queue: TrajQueue,
+        broadcast: DcnParamBroadcast,
+        expected_actors: List[int],
+        *,
+        host: Optional[str] = None,
+        port: int = 0,
+        heartbeat_grace_s: float = 30.0,
+        first_contact_grace_s: float = 300.0,
+        put_timeout_s: float = 5.0,
+    ):
+        self.traj_queue = traj_queue
+        self.broadcast = broadcast
+        self.expected_actors = list(expected_actors)
+        self.heartbeat_grace_s = float(heartbeat_grace_s)
+        self.first_contact_grace_s = float(first_contact_grace_s)
+        self.put_timeout_s = float(put_timeout_s)
+        self._lock = threading.Lock()
+        self._started = time.monotonic()
+        self._last_seen: Dict[int, float] = {}
+        self._goodbyes: Dict[int, str] = {}
+        self._latched: set = set()
+        self._peer_metrics: Dict[int, Dict[str, float]] = {}
+        self._commit_step = -1
+        # recent announcements, oldest first: a fast learner can announce
+        # two saves between actor polls (the commit manager runs async),
+        # and a latest-wins slot would silently coalesce the earlier step
+        # — its shard would never be written and rank 0's commit would
+        # time out.  Actors replay every step on this list.
+        self._commit_steps: List[int] = []
+        self._preempt = False
+        self._done = False
+        self._stopped = False
+        self.error: Optional[BaseException] = None
+        # Dcn/* counters
+        self.segments_accepted = 0
+        self.segments_rejected = 0
+        self.segment_bytes = 0
+        self.backpressured = 0
+
+        front = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *args: Any) -> None:  # quiet
+                pass
+
+            def _reply(self, code: int, body: bytes = b"", headers: Optional[Dict[str, str]] = None) -> None:
+                self.send_response(code)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                if body:
+                    self.wfile.write(body)
+
+            def _reply_json(self, code: int, obj: Dict[str, Any]) -> None:
+                body = json.dumps(obj).encode()
+                self._reply(code, body, {"Content-Type": "application/json"})
+
+            def _read_body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0) or 0)
+                return self.rfile.read(n) if n else b""
+
+            def do_GET(self) -> None:
+                try:
+                    if self.path.startswith("/healthz"):
+                        self._reply_json(200, {"ok": True, "actors": len(front._last_seen)})
+                    elif self.path.startswith("/params"):
+                        front._serve_params(self)
+                    else:
+                        self._reply(404)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+            def do_POST(self) -> None:
+                try:
+                    if self.path.startswith("/segment"):
+                        front._accept_segment(self)
+                    elif self.path.startswith("/poll"):
+                        front._accept_poll(self)
+                    elif self.path.startswith("/goodbye"):
+                        front._accept_goodbye(self)
+                    else:
+                        self._reply(404)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+
+        self._httpd = ThreadingHTTPServer((host or advertise_host(), int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[0], self._httpd.server_address[1]
+        self._serve_thread = threading.Thread(
+            target=self._httpd.serve_forever, name="dcn.front", daemon=True
+        )
+        self._monitor_thread = threading.Thread(
+            target=self._monitor, name="dcn.front.monitor", daemon=True
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "LearnerFront":
+        self._serve_thread.start()
+        self._monitor_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+        except Exception:
+            pass
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._serve_thread.join(timeout)
+
+    # -- handler bodies (run on server threads) -------------------------------
+    def _serve_params(self, handler: Any) -> None:
+        from urllib.parse import parse_qs, urlparse
+
+        q = parse_qs(urlparse(handler.path).query)
+        have = int(q.get("have", ["-1"])[0])
+        served = self.broadcast.payload_for(have)
+        if served is None:
+            handler._reply(204)
+            return
+        payload, crc, version = served
+        # the dcn.broadcast fault site: wire damage AFTER the CRC stamp,
+        # per fetch — the client's CRC check rejects and refetches
+        payload = fault_bytes("dcn.broadcast", payload)
+        handler._reply(
+            200,
+            payload,
+            {
+                "Content-Type": "application/octet-stream",
+                "X-Sheeprl-Version": str(version),
+                "X-Sheeprl-CRC32": str(crc),
+            },
+        )
+
+    def _accept_segment(self, handler: Any) -> None:
+        payload = handler._read_body()
+        want_crc = int(handler.headers.get("X-Sheeprl-CRC32", "-1"))
+        if _crc(payload) != want_crc:
+            # torn segment: the wire damaged it (or the dcn.traj fault
+            # site did) — REJECT, never enqueue; the sender retries
+            with self._lock:
+                self.segments_rejected += 1
+            from sheeprl_tpu.telemetry import RECORDER
+
+            RECORDER.record("dcn.torn_segment", rank=handler.headers.get("X-Sheeprl-Rank"))
+            handler._reply_json(409, {"error": "crc mismatch: torn segment rejected"})
+            return
+        meta = json.loads(handler.headers.get("X-Sheeprl-Meta", "{}") or "{}")
+        rank = int(handler.headers.get("X-Sheeprl-Rank", -1))
+        self._touch(rank)
+        try:
+            segment = pickle.loads(payload)
+        except Exception:
+            with self._lock:
+                self.segments_rejected += 1
+            handler._reply_json(409, {"error": "undecodable segment rejected"})
+            return
+        deadline = time.monotonic() + self.put_timeout_s
+        try:
+            # bounded put: the HTTP reply IS the backpressure signal (the
+            # client retries 503), so never sit on a server thread for the
+            # queue's full multi-minute timeout
+            self.traj_queue.put(
+                segment,
+                meta=meta,
+                abort=lambda: self._stopped or time.monotonic() > deadline,
+            )
+        except TornTrajectory as e:
+            # the queue's own validation (wrong segment length) holds
+            # across the process boundary: same reject, different wire code
+            with self._lock:
+                self.segments_rejected += 1
+            handler._reply_json(409, {"error": f"torn segment rejected: {e}"})
+            return
+        except ServiceStopped:
+            if self._stopped or self._done:
+                handler._reply_json(410, {"error": "learner gone"})
+            else:
+                with self._lock:
+                    self.backpressured += 1
+                handler._reply_json(503, {"error": "trajectory queue full"})
+            return
+        except QueueFull:
+            with self._lock:
+                self.backpressured += 1
+            handler._reply_json(503, {"error": "trajectory queue full"})
+            return
+        with self._lock:
+            self.segments_accepted += 1
+            self.segment_bytes += len(payload)
+        handler._reply_json(200, {"ok": True})
+
+    def _accept_poll(self, handler: Any) -> None:
+        body = json.loads(handler._read_body() or b"{}")
+        rank = int(body.get("rank", -1))
+        self._touch(rank)
+        if body.get("applied_version") is not None:
+            self.broadcast.note_applied(rank, int(body["applied_version"]))
+        if body.get("latched"):
+            with self._lock:
+                self._latched.add(rank)
+        hub = body.get("hub")
+        if isinstance(hub, dict):
+            with self._lock:
+                self._peer_metrics[rank] = {
+                    str(k): float(v) for k, v in hub.items() if isinstance(v, (int, float))
+                }
+        with self._lock:
+            resp = {
+                "version": self.broadcast.version,
+                "commit_step": self._commit_step,
+                "commit_steps": list(self._commit_steps),
+                "preempt": self._preempt or bool(self._latched),
+                "done": self._done,
+            }
+        handler._reply_json(200, resp)
+
+    def _accept_goodbye(self, handler: Any) -> None:
+        body = json.loads(handler._read_body() or b"{}")
+        rank = int(body.get("rank", -1))
+        with self._lock:
+            self._goodbyes[rank] = str(body.get("reason", ""))
+        handler._reply_json(200, {"ok": True})
+
+    # -- control plane (learner loop side) ------------------------------------
+    def _touch(self, rank: int) -> None:
+        if rank < 0:
+            return
+        with self._lock:
+            self._last_seen[rank] = time.monotonic()
+
+    def set_commit(self, step: int) -> None:
+        """Announce a commit step: every actor cell writes its shard into
+        ``step_dir(step)`` when its next poll observes it.  Announcements
+        accumulate (bounded) rather than overwrite, so back-to-back saves
+        both reach actors that poll less often than the learner commits."""
+        with self._lock:
+            self._commit_step = int(step)
+            self._commit_steps.append(int(step))
+            # shards for announcements older than ~16 saves are moot —
+            # rank 0's commit wait for them has long expired
+            del self._commit_steps[:-16]
+
+    def request_preempt(self) -> None:
+        with self._lock:
+            self._preempt = True
+
+    def set_done(self) -> None:
+        with self._lock:
+            self._done = True
+
+    @property
+    def actor_latched(self) -> bool:
+        """An actor cell's SIGTERM latch, surfaced by its poll — the
+        learner adopts it (coordinated preemption crosses the DCN both
+        ways)."""
+        with self._lock:
+            return bool(self._latched)
+
+    def wait_for_cells(self, timeout_s: float = 300.0) -> None:
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(r in self._last_seen for r in self.expected_actors):
+                    return
+            if self.error is not None:
+                raise self.error
+            time.sleep(0.1)
+        with self._lock:
+            missing = [r for r in self.expected_actors if r not in self._last_seen]
+        raise TimeoutError(f"pod actor cells {missing} never contacted the learner front")
+
+    def wait_goodbyes(self, timeout_s: float = 30.0) -> bool:
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if all(r in self._goodbyes for r in self.expected_actors):
+                    return True
+            time.sleep(0.1)
+        return False
+
+    # -- liveness -------------------------------------------------------------
+    def _monitor(self) -> None:
+        while not self._stopped:
+            time.sleep(1.0)
+            if self._stopped or self._done:
+                return
+            now = time.monotonic()
+            with self._lock:
+                for rank in self.expected_actors:
+                    if rank in self._goodbyes:
+                        continue
+                    seen = self._last_seen.get(rank)
+                    grace = self.heartbeat_grace_s if seen else self.first_contact_grace_s
+                    ref = seen if seen else self._started
+                    if now - ref > grace:
+                        if self.error is None:
+                            from sheeprl_tpu.telemetry import RECORDER
+
+                            RECORDER.record(
+                                "dcn.peer_lost", rank=rank, silent_s=round(now - ref, 1)
+                            )
+                            self.error = PeerLost(
+                                f"pod actor cell {rank} silent for {now - ref:.1f}s "
+                                f"(heartbeat_grace_s={grace:g})"
+                            )
+                        return
+
+    # -- telemetry ------------------------------------------------------------
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            out = {
+                "Dcn/segments_accepted": float(self.segments_accepted),
+                "Dcn/segments_rejected": float(self.segments_rejected),
+                "Dcn/segment_bytes": float(self.segment_bytes),
+                "Dcn/backpressured": float(self.backpressured),
+                "Dcn/actor_cells": float(len(self._last_seen)),
+            }
+            # rank-0 aggregation: every cell's hub snapshot, namespaced by
+            # pod rank, lands in the learner's metric stream (cells that
+            # already namespace their hub keep their own prefix)
+            for rank, snap in self._peer_metrics.items():
+                for k, v in snap.items():
+                    out[k if k.startswith("rank") else f"rank{rank}/{k}"] = v
+        out.update(self.broadcast.metrics())
+        return out
+
+
+class PodClient:
+    """An actor cell's connection to the learner front."""
+
+    def __init__(
+        self,
+        address: str,
+        rank: int,
+        *,
+        push_deadline_s: float = 300.0,
+        request_timeout_s: float = 10.0,
+        heartbeat_grace_s: float = 30.0,
+    ):
+        self.base = f"http://{address}"
+        self.rank = int(rank)
+        self.push_deadline_s = float(push_deadline_s)
+        self.request_timeout_s = float(request_timeout_s)
+        self.heartbeat_grace_s = float(heartbeat_grace_s)
+        self._lock = threading.Lock()
+        self._first_failure: Optional[float] = None
+        # Dcn/* counters
+        self.segments_pushed = 0
+        self.push_retries = 0
+        self.push_wait_s = 0.0
+        self.torn_rejected = 0
+        self.fetches = 0
+        self.fetch_crc_rejects = 0
+
+    # -- plumbing -------------------------------------------------------------
+    def _request(
+        self, path: str, data: Optional[bytes] = None, headers: Optional[Dict[str, str]] = None
+    ) -> Tuple[int, bytes, Dict[str, str]]:
+        req = urllib.request.Request(
+            self.base + path, data=data, headers=headers or {}, method="POST" if data is not None else "GET"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.request_timeout_s) as resp:
+                self._note_ok()
+                return resp.status, resp.read(), dict(resp.headers)
+        except urllib.error.HTTPError as e:
+            self._note_ok()  # the server answered: it is alive
+            return e.code, e.read(), dict(e.headers)
+
+    def _note_ok(self) -> None:
+        with self._lock:
+            self._first_failure = None
+
+    def _note_failure(self) -> None:
+        """Track learner silence; raise PeerLost past the grace window —
+        the actor cell must not spin against a dead learner forever."""
+        now = time.monotonic()
+        with self._lock:
+            if self._first_failure is None:
+                self._first_failure = now
+            silent = now - self._first_failure
+        if silent > self.heartbeat_grace_s:
+            from sheeprl_tpu.telemetry import RECORDER
+
+            RECORDER.record("dcn.peer_lost", rank=0, silent_s=round(silent, 1))
+            raise PeerLost(
+                f"learner front unreachable for {silent:.1f}s "
+                f"(heartbeat_grace_s={self.heartbeat_grace_s:g})"
+            )
+
+    # -- data plane -----------------------------------------------------------
+    def push_segment(self, segment: Dict[str, Any], meta: Optional[Dict[str, Any]] = None) -> None:
+        """Deliver one segment, never dropping: 503 (backpressure) and 409
+        (torn on the wire) retry until ``push_deadline_s``; a dead learner
+        raises :class:`PeerLost` after ``heartbeat_grace_s``."""
+        payload = pickle.dumps(segment, protocol=pickle.HIGHEST_PROTOCOL)
+        crc = _crc(payload)
+        deadline = time.monotonic() + self.push_deadline_s
+        t0 = time.monotonic()
+        attempt = 0
+        while True:
+            attempt += 1
+            # per-attempt fault application: a corrupted attempt is
+            # rejected by the receiver's CRC and the NEXT attempt ships the
+            # clean buffer — wire damage costs a retry, never a segment
+            wire = fault_bytes("dcn.traj", payload)
+            headers = {
+                "Content-Type": "application/octet-stream",
+                "X-Sheeprl-CRC32": str(crc),
+                "X-Sheeprl-Rank": str(self.rank),
+                "X-Sheeprl-Meta": json.dumps(meta or {}),
+            }
+            try:
+                status, _body, _ = self._request("/segment", wire, headers)
+            except (urllib.error.URLError, ConnectionError, socket.timeout, OSError):
+                self._note_failure()
+                status = -1
+            if status == 200:
+                with self._lock:
+                    self.segments_pushed += 1
+                    self.push_retries += attempt - 1
+                    self.push_wait_s += time.monotonic() - t0
+                return
+            if status == 409:
+                with self._lock:
+                    self.torn_rejected += 1
+                err = b""
+                try:
+                    err = json.loads(_body or b"{}").get("error", "").encode()
+                except Exception:
+                    pass
+                if b"crc" not in err:
+                    # structurally torn (wrong segment shape): retrying the
+                    # same buffer can never succeed — fail loudly NOW
+                    raise TornTrajectory(err.decode() or "segment rejected by learner")
+            if status == 410:
+                raise ServiceStopped("learner front is gone (run finished)")
+            if time.monotonic() > deadline:
+                raise SegmentPushError(
+                    f"segment undeliverable after {self.push_deadline_s:g}s "
+                    f"({attempt} attempts, last status {status})"
+                )
+            time.sleep(0.05 if status in (409, 503) else 0.25)
+
+    def fetch_params(self, have_version: int) -> Optional[Tuple[Any, int]]:
+        """Newest ``(params, version)`` when the learner has something
+        fresher than ``have_version`` (else None).  CRC-verified: a torn
+        broadcast is counted and refetched, never applied."""
+        try:
+            status, body, headers = self._request(f"/params?have={int(have_version)}&rank={self.rank}")
+        except (urllib.error.URLError, ConnectionError, socket.timeout, OSError):
+            self._note_failure()
+            return None
+        if status != 200:
+            return None
+        want_crc = int(headers.get("X-Sheeprl-CRC32", "-1"))
+        if _crc(body) != want_crc:
+            with self._lock:
+                self.fetch_crc_rejects += 1
+            from sheeprl_tpu.telemetry import RECORDER
+
+            RECORDER.record("dcn.torn_broadcast", rank=self.rank)
+            return None
+        with self._lock:
+            self.fetches += 1
+        return pickle.loads(body), int(headers.get("X-Sheeprl-Version", "0"))
+
+    # -- control plane --------------------------------------------------------
+    def poll(
+        self,
+        applied_version: int,
+        *,
+        latched: bool = False,
+        hub: Optional[Dict[str, float]] = None,
+    ) -> Optional[Dict[str, Any]]:
+        body = json.dumps(
+            {
+                "rank": self.rank,
+                "applied_version": int(applied_version),
+                "latched": bool(latched),
+                "hub": hub or {},
+            }
+        ).encode()
+        try:
+            status, resp, _ = self._request("/poll", body, {"Content-Type": "application/json"})
+        except (urllib.error.URLError, ConnectionError, socket.timeout, OSError):
+            self._note_failure()
+            return None
+        if status != 200:
+            return None
+        return json.loads(resp)
+
+    def goodbye(self, reason: str = "") -> None:
+        body = json.dumps({"rank": self.rank, "reason": reason}).encode()
+        try:
+            self._request("/goodbye", body, {"Content-Type": "application/json"})
+        except Exception:
+            pass
+
+    def metrics(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "Dcn/segments_pushed": float(self.segments_pushed),
+                "Dcn/push_retries": float(self.push_retries),
+                "Dcn/push_wait_s": float(self.push_wait_s),
+                "Dcn/torn_rejected": float(self.torn_rejected),
+                "Dcn/param_fetches": float(self.fetches),
+                "Dcn/fetch_crc_rejects": float(self.fetch_crc_rejects),
+            }

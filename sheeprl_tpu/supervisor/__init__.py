@@ -21,6 +21,7 @@ from sheeprl_tpu.supervisor.classify import (
     crash_error,
     load_postmortem,
 )
+from sheeprl_tpu.supervisor.pod import PodSupervisor, resolve_supervisor
 from sheeprl_tpu.supervisor.supervise import (
     EXIT_BREAKER,
     EXIT_BUDGET,
@@ -36,6 +37,7 @@ __all__ = [
     "EXIT_BUDGET",
     "EXIT_OK",
     "PREEMPTED",
+    "PodSupervisor",
     "SUCCESS",
     "TRANSIENT",
     "Supervisor",
@@ -44,4 +46,5 @@ __all__ = [
     "crash_error",
     "load_postmortem",
     "main",
+    "resolve_supervisor",
 ]

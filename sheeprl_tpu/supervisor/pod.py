@@ -1,0 +1,250 @@
+"""The pod-aware supervisor: collective restart for multi-process runs.
+
+One :class:`PodSupervisor` drives one pod: every episode it spawns ALL
+``num_processes`` cells of the fake-DCN protocol (fresh coordinator port,
+``SHEEPRL_DCN_*`` env per cell, rank-prefixed output) and applies the pod's
+collective failure semantics on top of the single-child machinery it
+inherits from :class:`~sheeprl_tpu.supervisor.supervise.Supervisor`:
+
+* **any-cell crash is pod death** — a cell exiting NONZERO while its
+  peers live (SIGKILLed host, crash, watchdog hard-exit 75) triggers
+  coordinated teardown: SIGTERM to every survivor (their preemption
+  latches run final committed saves where possible), SIGKILL past
+  ``kill_grace_s``.  No rank is left training past a dead peer — the
+  in-run :class:`~sheeprl_tpu.parallel.distributed.PeerWatchdog` enforces
+  this from the inside; the supervisor enforces it from the outside.  A
+  cell exiting ZERO is the done→goodbye protocol completing, not a death
+  (actors routinely finish a beat before the learner's finalize).
+* **collective restart** — classification (breaker/budget/backoff) is the
+  inherited single-run logic, fed by the learner's exit status and the
+  episode's most *telling* postmortem: the newest NON-preemption document
+  (the culprit's crash evidence) when one exists, else the newest overall
+  (everyone honoring the latch = a preemption verdict).  A restart
+  relaunches ALL ranks with ``checkpoint.resume_from=auto`` appended —
+  every cell resumes from the newest COMMIT under the shared checkpoint
+  root, so the pod restarts from one agreed snapshot.
+* **audit** — the same ``supervisor_log.jsonl`` line per episode, with a
+  ``cells`` block recording each rank's return code.
+
+The heartbeat/stall watchdog it inherits keys on the learner cell (rank 0
+owns the introspection endpoint the URL regex finds first) — a wedged
+learner is killed and the teardown above fans out to the actors.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from sheeprl_tpu.parallel.distributed import (
+    ENV_COORD,
+    ENV_FAKE,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    free_port,
+)
+from sheeprl_tpu.supervisor.classify import load_postmortem
+from sheeprl_tpu.supervisor.supervise import _URL_RE, Supervisor
+
+
+class PodSupervisor(Supervisor):
+    """Episodes of an entire pod instead of a single child."""
+
+    def __init__(
+        self,
+        cfg: Any,
+        argv: List[str],
+        num_processes: int,
+        *,
+        child_cmd: Optional[Callable[[List[str]], List[str]]] = None,
+        child_env: Optional[Dict[str, str]] = None,
+        handle_signals: bool = True,
+    ):
+        super().__init__(
+            cfg, argv, child_cmd=child_cmd, child_env=child_env, handle_signals=handle_signals
+        )
+        if num_processes < 2:
+            raise ValueError("PodSupervisor needs num_processes >= 2 (use Supervisor)")
+        self.num_processes = int(num_processes)
+        self._cells: List[subprocess.Popen] = []
+
+    # -- spawning: the whole pod ----------------------------------------------
+    def _spawn(self, episode: int) -> subprocess.Popen:
+        cmd = self._child_cmd(self._episode_argv(episode))
+        base_env = dict(os.environ)
+        if self._child_env is not None:
+            base_env.update(self._child_env)
+        base_env.pop(ENV_PROCESS_ID, None)
+        xla_flags = base_env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in xla_flags:
+            base_env["XLA_FLAGS"] = (
+                xla_flags + " --xla_force_host_platform_device_count=1"
+            ).strip()
+        coord = f"127.0.0.1:{free_port()}"  # fresh coordinator per episode
+        self._url = None
+        self._url_event.clear()
+        self._cells = []
+        for rank in range(self.num_processes):
+            env = dict(base_env)
+            env.update(
+                {
+                    ENV_FAKE: str(self.num_processes),
+                    ENV_PROCESS_ID: str(rank),
+                    ENV_NUM_PROCESSES: str(self.num_processes),
+                    ENV_COORD: coord,
+                    "JAX_PLATFORMS": "cpu",
+                }
+            )
+            cell = subprocess.Popen(
+                cmd, env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True
+            )
+            self._cells.append(cell)
+            threading.Thread(
+                target=self._relay, args=(cell, rank), name=f"pod-relay[{rank}]", daemon=True
+            ).start()
+        # rank 0 (the learner cell — it writes COMMIT and owns the
+        # introspection endpoint) is "the child" the inherited watch,
+        # returncode and classification key on
+        self._child = self._cells[0]
+        return self._cells[0]
+
+    def _relay(self, cell: subprocess.Popen, rank: int) -> None:
+        try:
+            for line in cell.stdout:  # type: ignore[union-attr]
+                sys.stdout.write(f"[dcn:{rank}] {line}")
+                sys.stdout.flush()
+                if rank == 0 and self._url is None:
+                    m = _URL_RE.search(line)
+                    if m:
+                        self._url = m.group(1)
+                        self._url_event.set()
+        except (ValueError, OSError):
+            pass  # pipe closed under us during teardown
+
+    # -- collective teardown ---------------------------------------------------
+    def _terminate_pod(self, exclude: Optional[subprocess.Popen] = None) -> None:
+        """SIGTERM every live cell (preemption latch → final save where the
+        checkpoint path still works), SIGKILL past ``kill_grace_s``."""
+        live = [c for c in self._cells if c is not exclude and c.poll() is None]
+        for c in live:
+            try:
+                c.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        deadline = time.monotonic() + self.kill_grace_s
+        for c in live:
+            try:
+                c.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                try:
+                    c.kill()
+                    c.wait(timeout=10.0)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+
+    def _kill_child(self, child: subprocess.Popen) -> None:
+        # the inherited watchdog decided the learner is hung: the whole pod
+        # goes down with it — survivors would only block on a dead front
+        super()._kill_child(child)
+        self._terminate_pod(exclude=child)
+
+    def _watch(self, child: subprocess.Popen, started: float) -> bool:
+        """The inherited learner heartbeat watch, plus the pod rule: ANY
+        cell exiting ends the episode for every rank."""
+        sidecar_stop = threading.Event()
+
+        def sidecar() -> None:
+            while not sidecar_stop.wait(0.5):
+                dead = {i: c.poll() for i, c in enumerate(self._cells) if c.poll() is not None}
+                if 0 in dead:
+                    return  # the inherited watch sees the learner exit itself
+                # only a CRASHED peer is pod death.  An actor exiting 0 is
+                # the done→goodbye protocol completing (it routinely beats
+                # the learner's own finalize by a few seconds) — tearing
+                # the learner down for it turns every successful episode
+                # into a SIGTERM "failure".  An actor that exits 0 when it
+                # should NOT have is the learner front's heartbeat-grace /
+                # PeerLost problem, handled inside the run.
+                crashed = [i for i, rc in dead.items() if rc != 0]
+                if not crashed:
+                    continue
+                rc = dead[crashed[0]]
+                self._log_line(
+                    f"pod cell {crashed[0]} exited (rc={rc}) — coordinated teardown"
+                )
+                # give the survivors one grace window to notice on their own
+                # (PeerWatchdog/PeerLost) and commit final saves, then the
+                # teardown escalates for real
+                self._terminate_pod()
+                return
+
+        t = threading.Thread(target=sidecar, name="pod-sidecar", daemon=True)
+        t.start()
+        try:
+            hung = super()._watch(child, started)
+        finally:
+            sidecar_stop.set()
+            t.join(timeout=2.0)
+        # the learner is down (exit or kill): reap the rest before
+        # classification so the next episode never races leftover cells
+        # over the coordinator port or the checkpoint root
+        self._terminate_pod(exclude=child)
+        return hung
+
+    # -- evidence --------------------------------------------------------------
+    def _find_postmortem(self, not_before: float) -> Optional[str]:
+        """Prefer the episode's newest NON-preemption postmortem: in a
+        coordinated teardown every surviving rank honors the latch and
+        writes a ``reason: preemption`` document — the one cell that
+        actually crashed wrote the document worth classifying."""
+        import glob as _glob
+
+        candidates: List[tuple] = []
+        for path in _glob.glob(
+            os.path.join(_glob.escape(self.exp_root), "**", "postmortem.json"), recursive=True
+        ):
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            if mtime > not_before - 1e-3:
+                candidates.append((mtime, path))
+        if not candidates:
+            return None
+        candidates.sort()
+        for _, path in reversed(candidates):
+            doc = load_postmortem(path)
+            if doc is not None and str(doc.get("reason", "")) != "preemption":
+                return path
+        return candidates[-1][1]
+
+    # -- audit ----------------------------------------------------------------
+    def _append_audit(self, record: Dict[str, Any]) -> None:
+        record = dict(record)
+        record["cells"] = [
+            {"rank": r, "returncode": c.poll()} for r, c in enumerate(self._cells)
+        ]
+        record["num_processes"] = self.num_processes
+        super()._append_audit(record)
+
+
+def resolve_supervisor(cfg: Any, argv: List[str], **kwargs: Any) -> Supervisor:
+    """The launch-time routing: a pod-shaped invocation (``SHEEPRL_FAKE_DCN``
+    set, or ``fabric.distributed.num_processes`` configured > 1) gets the
+    :class:`PodSupervisor`; everything else the plain :class:`Supervisor`."""
+    from sheeprl_tpu.parallel.distributed import distributed_cfg
+
+    num = int(os.environ.get(ENV_FAKE, 0) or 0)
+    if num <= 1:
+        num = int(distributed_cfg(cfg).get("num_processes") or 0)
+    if num > 1:
+        env = dict(kwargs.pop("child_env", None) or {})
+        # the launcher-mode env var must NOT leak into the cells as a
+        # re-launch trigger; _spawn sets the full per-cell protocol itself
+        return PodSupervisor(cfg, argv, num, child_env=env or None, **kwargs)
+    return Supervisor(cfg, argv, **kwargs)

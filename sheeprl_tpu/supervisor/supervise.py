@@ -446,6 +446,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     path knobs), then supervises the child invocation."""
     argv = list(sys.argv[1:] if argv is None else argv)
     from sheeprl_tpu.config.compose import compose
+    from sheeprl_tpu.supervisor.pod import resolve_supervisor
 
     cfg = compose(argv)
-    sys.exit(Supervisor(cfg, argv).run())
+    sys.exit(resolve_supervisor(cfg, argv).run())

@@ -39,6 +39,16 @@ class TelemetryHub:
         self._sources: Dict[str, Tuple[Callable[[], Dict[str, float]], Optional[Callable[[], None]]]] = {}
         self._logger: Any = None
         self.last_step: int = 0
+        self._namespace: Optional[str] = None
+
+    # -- namespacing (multi-process runs) ------------------------------------
+    def set_namespace(self, prefix: Optional[str]) -> None:
+        """Prefix every flushed metric with ``<prefix>/`` — pod actor cells
+        set their rank (``rank2``) so their scrapes and the control-plane
+        snapshots they ship to the learner's rank-0 aggregation stay
+        distinguishable from the learner's own counters.  ``None`` clears."""
+        with self._lock:
+            self._namespace = str(prefix) if prefix else None
 
     # -- registration --------------------------------------------------------
     def register(
@@ -70,12 +80,15 @@ class TelemetryHub:
         AFTER collection, so rolling flushes see the full window."""
         with self._lock:
             items = list(self._sources.items())
+            namespace = self._namespace
         out: Dict[str, float] = {}
         for _, (fn, _on_roll) in items:
             try:
                 out.update(fn() or {})
             except Exception:
                 continue
+        if namespace:
+            out = {f"{namespace}/{k}": v for k, v in out.items()}
         if roll:
             for _, (_fn, on_roll) in items:
                 if on_roll is not None:

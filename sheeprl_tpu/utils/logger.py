@@ -125,7 +125,10 @@ def get_log_dir(fabric: Any, root_dir: str, run_name: str, base: str = "logs/run
         os.makedirs(log_dir, exist_ok=True)
     else:
         log_dir = None
-    if fabric is not None and fabric.world_size > 1:
+    if fabric is not None and (fabric.world_size > 1 or fabric.num_processes > 1):
+        # num_processes matters independently of world_size: a pod of
+        # single-device cells still needs every process to agree on rank
+        # 0's version_N pick
         log_dir = fabric.broadcast_object(log_dir, src=0)
     return log_dir
 
