@@ -980,6 +980,209 @@ def bench_env() -> dict:
     }
 
 
+def bench_population() -> dict:
+    """Population-axis scaling bench (``--mode population`` /
+    ``BENCH_TARGET=population``, ISSUE 20): per-member env-steps/s of a
+    population=P CartPole phase — rollout + policy-gradient update + the
+    in-trace PBT exploit/explore gate, vmapped over P members inside ONE
+    donated-carry fused executable — against the SAME member phase compiled
+    single-agent.
+
+    ``per_member_scaling = (pop_rate / P) / single_rate``: the fraction of
+    a lone agent's throughput each population member retains.  GATES the
+    ISSUE 20 acceptance: ``per_member_scaling >= 0.7 x hardware-ideal`` at
+    P=4 (training 4 members together must cost well under 4 sequential
+    runs — the batched population is the point) and ``steady_compiles ==
+    0`` with both executables at ``cache_size() == 1`` under the armed
+    transfer guard (``h2d_bytes_per_update == 0`` by guard completion).
+
+    The hardware-ideal term keeps the gate honest across hosts: on an
+    accelerator (or any host with >= P cores) ideal is 1.0 and the gate is
+    the plain ``>= 0.7``; on an N-core CPU host with N < P the members'
+    compute genuinely serializes, so ideal degrades to ``N / P`` — the
+    gate then measures the vmap/PBT machinery's *overhead* rather than
+    penalizing the host for lacking parallel compute units.
+    """
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from sheeprl_tpu.algos.ppo.agent import sample_actions
+    from sheeprl_tpu.envs.jax.anakin import make_rollout_fn
+    from sheeprl_tpu.envs.jax.cartpole import JaxCartPole
+    from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+    from sheeprl_tpu.parallel.fabric import Fabric
+    from sheeprl_tpu.population import (
+        PBTConfig,
+        init_population_state,
+        make_population_phase,
+        tile_stack,
+    )
+    from sheeprl_tpu.utils.profiler import COMPILE_MONITOR
+    from sheeprl_tpu.utils.structured import dotdict
+    from sheeprl_tpu.utils.utils import device_sync
+
+    pop_size = int(os.environ.get("BENCH_POP_SIZE", 4))
+    num_envs = int(os.environ.get("BENCH_POP_ENVS", 64))
+    rollout_steps = int(os.environ.get("BENCH_POP_ROLLOUT", 32))
+    iters = int(os.environ.get("BENCH_POP_ITERS", 16))
+
+    fabric = Fabric(devices=1)
+    venv = VectorJaxEnv(JaxCartPole(), num_envs)
+
+    def apply(p, obs):
+        h = jnp.tanh(obs["state"] @ p["w1"]) @ p["w2"]
+        return h[..., :2], h[..., 2:3]
+
+    rollout_fn = make_rollout_fn(
+        venv,
+        apply,
+        lambda out, k: sample_actions(out, (2,), False, k),
+        cnn_keys=(),
+        mlp_keys=("state",),
+        action_space=venv.single_action_space,
+        gamma=0.99,
+        rollout_steps=rollout_steps,
+    )
+
+    def pg_loss(p, traj):
+        # one-step PG surrogate + value regression: a real gradient through
+        # the policy net, small enough that env stepping stays the axis
+        logits, value = apply(p, traj)
+        logp = jax.nn.log_softmax(logits)
+        act = traj["actions"][..., 0].astype(jnp.int32)
+        chosen = jnp.take_along_axis(logp, act[..., None], axis=-1)[..., 0]
+        adv = traj["rewards"] - jax.lax.stop_gradient(value[..., 0])
+        return (-chosen * adv).mean() + 0.5 * ((value[..., 0] - traj["rewards"]) ** 2).mean()
+
+    def member_phase(p, o_state, actor, k, hp):
+        actor, traj, last_obs, stats = rollout_fn(p, actor, k)
+        grads = jax.grad(pg_loss)(p, traj)
+        p = jax.tree.map(lambda w, g: w - hp["lr"] * g, p, grads)
+        o_state = jax.tree.map(lambda m, g: 0.9 * m + g, o_state, grads)
+        return p, o_state, actor, (jnp.zeros(()),), stats
+
+    def init_params(key):
+        k1, k2 = jax.random.split(key)
+        return {
+            "w1": 0.1 * jax.random.normal(k1, (4, 32), jnp.float32),
+            "w2": 0.1 * jax.random.normal(k2, (32, 3), jnp.float32),
+        }
+
+    def init_actor(key):
+        env_state, _ = venv.reset(key)
+        return {
+            "env": env_state,
+            "ep_ret": jnp.zeros((num_envs,), jnp.float32),
+            "ep_len": jnp.zeros((num_envs,), jnp.int32),
+            "update": jnp.zeros((), jnp.int32),
+        }
+
+    pbt_cfg = PBTConfig.from_cfg(
+        dotdict(
+            {
+                "population": dict(
+                    size=pop_size, exploit_every=5, warmup=2, frac=0.25,
+                    perturb_min=0.8, perturb_max=1.25, init_min=0.5,
+                    init_max=2.0, bound_min=0.05, bound_max=20.0,
+                    fitness_alpha=0.3, levels=None,
+                )
+            }
+        ),
+        base={"lr": 1e-2},
+    )
+
+    def _measure(step_fn, args, env_steps_per_iter, keep=None):
+        # `keep`: how many leading outputs feed back as the next call's args
+        # (the population phase also returns losses/stats, which don't)
+        t0 = time.perf_counter()
+        args = step_fn(*args)[:keep]
+        device_sync(args)
+        first_call_s = time.perf_counter() - t0
+        n0, _ = COMPILE_MONITOR.totals()
+        t0 = time.perf_counter()
+        with jax.transfer_guard_host_to_device("disallow"):
+            for _ in range(iters):
+                args = step_fn(*args)[:keep]
+        device_sync(args)
+        wall = time.perf_counter() - t0
+        n1, _ = COMPILE_MONITOR.totals()
+        return {
+            "rate": env_steps_per_iter * iters / wall,
+            "first_call_s": first_call_s,
+            "steady_compiles": n1 - n0,
+            "cache_size": step_fn.cache_size(),
+        }
+
+    # ---- single-agent Anakin arm (fixed hyperparams baked in) -------------
+    single_hp = {"lr": jnp.float32(1e-2)}
+
+    def single_fused(p, o_state, actor, k):
+        k, k_m = jax.random.split(k)
+        p, o_state, actor, _, _ = member_phase(p, o_state, actor, k_m, single_hp)
+        return p, o_state, actor, k
+
+    single_step = fabric.compile(
+        single_fused, name="bench.population.single", donate_argnums=(0, 1, 2)
+    )
+    params1 = fabric.replicate(init_params(jax.random.PRNGKey(0)))
+    opt1 = jax.tree.map(jnp.zeros_like, params1)
+    single = _measure(
+        single_step,
+        (params1, opt1, init_actor(jax.random.PRNGKey(1)), jax.random.PRNGKey(2)),
+        num_envs * rollout_steps,
+    )
+
+    # ---- population arm (P members + in-trace PBT, one executable) --------
+    population_step = fabric.compile(
+        make_population_phase(member_phase, pbt_cfg),
+        name="bench.population.phase",
+        donate_argnums=(0, 1, 2, 3),
+    )
+    params = jax.vmap(init_params)(jax.random.split(jax.random.PRNGKey(0), pop_size))
+    opt = jax.tree.map(jnp.zeros_like, params)
+    members = jax.vmap(init_actor)(jax.random.split(jax.random.PRNGKey(1), pop_size))
+    pop_state = init_population_state(members, pbt_cfg, num_envs)
+    hp = pbt_cfg.init_hyperparams(jax.random.PRNGKey(3))
+    pop = _measure(
+        population_step,
+        (params, opt, pop_state, hp, jax.random.PRNGKey(4)),
+        pop_size * num_envs * rollout_steps,
+        keep=5,
+    )
+
+    per_member_rate = pop["rate"] / pop_size
+    scaling = per_member_rate / single["rate"]
+    steady_compiles = single["steady_compiles"] + pop["steady_compiles"]
+    cache_ok = single["cache_size"] == 1 and pop["cache_size"] == 1
+    dev = jax.devices()[0]
+    ideal = min(1.0, (os.cpu_count() or 1) / pop_size) if dev.platform == "cpu" else 1.0
+    scaling_floor = 0.7 * ideal
+    return {
+        "metric": (
+            f"per_member_env_steps_per_s (cartpole pop={pop_size} x{num_envs} envs "
+            f"vs single-agent anakin, {dev.platform})"
+        ),
+        "value": round(per_member_rate, 1),
+        "unit": "env_steps/s",
+        "per_member_scaling": round(scaling, 3),
+        "per_member_scaling_floor": round(scaling_floor, 3),
+        "env_steps_per_s_single": round(single["rate"], 1),
+        "env_steps_per_s_population_total": round(pop["rate"], 1),
+        "population_size": pop_size,
+        "n_envs_per_member": num_envs,
+        "first_call_s_single": round(single["first_call_s"], 3),
+        "first_call_s_population": round(pop["first_call_s"], 3),
+        "steady_compiles": steady_compiles,
+        "cache_size_single": single["cache_size"],
+        "cache_size_population": pop["cache_size"],
+        # guard completion over every steady window == zero H2D
+        "h2d_bytes_per_update": 0.0,
+        "gate_failed": not (scaling >= scaling_floor and steady_compiles == 0 and cache_ok),
+    }
+
+
 def bench_sebulba() -> dict:
     """Sebulba actor–learner topology bench (``--mode sebulba``, ISSUE 12).
 
@@ -1902,6 +2105,8 @@ def _run_bench() -> dict:
         return bench_health_overhead()
     if target == "env":
         return bench_env()
+    if target == "population":
+        return bench_population()
     if target == "sebulba":
         return bench_sebulba()
     if target == "dcn":

@@ -113,6 +113,15 @@ def main(fabric: Any, cfg: Any) -> None:
     # ---------------- environments -----------------------------------------
     num_envs = cfg.env.num_envs
     use_anakin = anakin_enabled(cfg, fabric)
+    # population mode (docs/population.md): vmap whole agents over a
+    # population axis INSIDE the fused Anakin executable, with in-trace PBT
+    pop_size = int(cfg.get("population", {}).get("size", 0) or 0)
+    use_population = pop_size > 1
+    if use_population and not use_anakin:
+        raise ValueError(
+            "population.size>1 rides the Anakin axis: it needs a pure-JAX env "
+            "(env=jax_*), algo.anakin != False, and a single-process run"
+        )
     if use_anakin:
         # Anakin mode (envs/jax/anakin.py): the env lives INSIDE the
         # compiled update — no vector-env processes exist at all
@@ -148,10 +157,16 @@ def main(fabric: Any, cfg: Any) -> None:
         # resume the train-dispatch RNG stream bit-exactly (rank-identical)
         key = jnp.asarray(state["key"])
     agent, params = build_agent(
-        fabric, actions_dim, is_continuous, cfg, obs_space, state.get("agent")
+        fabric, actions_dim, is_continuous, cfg, obs_space,
+        # population checkpoints hold STACKED (P, ...) params — restored in
+        # the population block below, not through the single-agent loader
+        None if (use_population and state) else state.get("agent"),
     )
     optimizer = build_optimizer(cfg.algo.optimizer, cfg.algo.max_grad_norm)
-    opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
+    if use_population:
+        opt_state = None  # stacked per-member init happens in the population block
+    else:
+        opt_state = fabric.replicate(state.get("opt_state") or optimizer.init(params))
 
     aggregator = MetricAggregator(
         cfg.metric.aggregator.metrics if cfg.metric.log_level > 0 else {}
@@ -334,6 +349,10 @@ def main(fabric: Any, cfg: Any) -> None:
         n_shards = 1  # uneven split: fall back to the global-pool sampler
     # GLOBAL env-step accounting: every process steps its own envs
     policy_steps_per_iter = num_envs * rollout_steps * fabric.num_processes
+    if use_population:
+        # every member steps its own env shard: the population multiplies
+        # the env steps per fused update, so total_steps buys fewer updates
+        policy_steps_per_iter *= pop_size
     total_iters = max(int(cfg.algo.total_steps) // policy_steps_per_iter, 1)
     if cfg.dry_run:
         total_iters = 1
@@ -412,15 +431,101 @@ def main(fabric: Any, cfg: Any) -> None:
             )
             return p, o_state, actor, k_next, losses, stats
 
-        anakin_step = fabric.compile(
-            anakin_phase,
-            name=f"{cfg.algo.name}.anakin_phase",
-            donate_argnums=(0, 1, 2),
-            max_recompiles=cfg.algo.get("max_recompiles"),
-        )
-        actor_state = init_actor_state(
-            fabric, venv, jax.random.fold_in(key, fabric.global_rank + 1), start_iter - 1, sharded_envs
-        )
+        if use_population:
+            # ------------ population: vmap whole agents over P ------------
+            from sheeprl_tpu import telemetry
+            from sheeprl_tpu.population import (
+                PBTConfig,
+                PopulationMonitor,
+                init_population_state,
+                make_population_phase,
+                tile_stack,
+                write_population_summary,
+            )
+
+            pbt_cfg = PBTConfig.from_cfg(
+                cfg,
+                base={"lr": base_lr, "ent_coef": initial_ent_coef, "clip_coef": initial_clip_coef},
+            )
+
+            def member_phase(p, o_state, actor, k, hp):
+                """ONE member's fused rollout+train with its hyperparameters
+                as traced data (lr through the injected opt-state, clip/ent
+                into the loss).  PBT replaces the anneal schedules, so the
+                ``algo.anneal_*`` flags are inert in population mode."""
+                k_roll, k_train = jax.random.split(k)
+                o_state = set_learning_rate(o_state, hp["lr"])
+                actor, rollout, last_obs, stats = rollout_fn(p, actor, k_roll)
+                p, o_state, losses = train_phase_fn(
+                    p,
+                    o_state,
+                    rollout,
+                    last_obs,
+                    k_train,
+                    hp["clip_coef"],
+                    hp["ent_coef"],
+                    batch_size=global_bs,
+                    num_minibatches=num_minibatches,
+                    share_data=share_data,
+                    n_shards=1,  # population runs are single-process (enforced above)
+                )
+                return p, o_state, actor, losses, stats
+
+            population_step = fabric.compile(
+                make_population_phase(member_phase, pbt_cfg),
+                name=f"{cfg.algo.name}.population_phase",
+                donate_argnums=(0, 1, 2, 3),
+                max_recompiles=cfg.algo.get("max_recompiles"),
+            )
+
+            # stacked member state: all members start from the SAME init
+            # (the hyperparameter spread diversifies them); opt-state is
+            # per-member so exploit can copy weights+moments coherently;
+            # each member gets its own seeded env shard
+            pop_resume = state.get("population") if state else None
+            if state:
+                params = fabric.replicate(jax.tree.map(jnp.asarray, state["agent"]))
+                opt_state = fabric.replicate(state["opt_state"])
+            else:
+                params = jax.device_put(tile_stack(params, pop_size), fabric.replicated)
+                opt_state = jax.device_put(jax.vmap(optimizer.init)(params), fabric.replicated)
+
+            def _init_member(k):
+                env_state, _ = venv.reset(k)
+                return {
+                    "env": env_state,
+                    "ep_ret": jnp.zeros((num_envs,), jnp.float32),
+                    "ep_len": jnp.zeros((num_envs,), jnp.int32),
+                }
+
+            members = jax.vmap(_init_member)(
+                jax.random.split(jax.random.fold_in(key, fabric.global_rank + 1), pop_size)
+            )
+            members["update"] = jnp.full((pop_size,), start_iter - 1, jnp.int32)
+            pop_state = init_population_state(members, pbt_cfg, num_envs)
+            if pop_resume:
+                pop_state["fitness"] = jnp.asarray(pop_resume["fitness"])
+                pop_state["ep_count"] = jnp.asarray(pop_resume["ep_count"])
+                pop_state["exploits"] = jnp.asarray(pop_resume["exploits"])
+                hp_state = {name: jnp.asarray(v) for name, v in pop_resume["hp"].items()}
+            else:
+                hp_state = pbt_cfg.init_hyperparams(jax.random.fold_in(key, pop_size))
+            pop_state = jax.device_put(pop_state, fabric.replicated)
+            hp_state = jax.device_put(hp_state, fabric.replicated)
+            pop_monitor = PopulationMonitor()
+            telemetry.HUB.register("population", pop_monitor)
+            anakin_step = None
+            actor_state = None
+        else:
+            anakin_step = fabric.compile(
+                anakin_phase,
+                name=f"{cfg.algo.name}.anakin_phase",
+                donate_argnums=(0, 1, 2),
+                max_recompiles=cfg.algo.get("max_recompiles"),
+            )
+            actor_state = init_actor_state(
+                fabric, venv, jax.random.fold_in(key, fabric.global_rank + 1), start_iter - 1, sharded_envs
+            )
         rb = None
     else:
         rb = ReplayBuffer(
@@ -456,10 +561,19 @@ def main(fabric: Any, cfg: Any) -> None:
             # -------- fused rollout+train: ONE dispatch per update ---------
             with timer("Time/train_time"):
                 with steady_guard(guard_on and update > start_iter):
-                    params, opt_state, actor_state, key, last_losses, ep_stats = anakin_step(
-                        params, opt_state, actor_state, key
-                    )
-                policy_step += num_envs * rollout_steps * fabric.num_processes
+                    if use_population:
+                        # the WHOLE population trains in this one dispatch
+                        params, opt_state, pop_state, hp_state, key, last_losses, ep_stats = (
+                            population_step(params, opt_state, pop_state, hp_state, key)
+                        )
+                    else:
+                        params, opt_state, actor_state, key, last_losses, ep_stats = anakin_step(
+                            params, opt_state, actor_state, key
+                        )
+                if use_population:
+                    # per-member (P,) losses → scalars for the aggregator
+                    last_losses = jax.tree.map(lambda x: x.mean(), last_losses)
+                policy_step += policy_steps_per_iter
             if cfg.metric.log_level > 0:
                 # completion arrays are tiny; the pull is D2H (legal under
                 # the H2D-scoped steady guard)
@@ -469,6 +583,12 @@ def main(fabric: Any, cfg: Any) -> None:
                 for ep_ret, ep_len in zip(rets, lens):
                     aggregator.update("Rewards/rew_avg", float(ep_ret))
                     aggregator.update("Game/ep_len_avg", int(ep_len))
+                if use_population:
+                    # Population/* hub family: tiny D2H pulls on the logging
+                    # cadence (the guard is H2D-scoped)
+                    pop_monitor.observe(
+                        pop_state["fitness"], hp_state, pop_state["exploits"]
+                    )
         else:
             with timer("Time/env_interaction_time"):
                 with jax.default_device(host):
@@ -596,6 +716,15 @@ def main(fabric: Any, cfg: Any) -> None:
                 "last_checkpoint": last_checkpoint,
                 "batch_size": global_bs,
             }
+            if use_population:
+                # params/opt_state above are already the stacked (P, ...)
+                # pytrees; the PBT carry rides its own subtree
+                ckpt_state["population"] = {
+                    "fitness": pop_state["fitness"],
+                    "ep_count": pop_state["ep_count"],
+                    "exploits": pop_state["exploits"],
+                    "hp": hp_state,
+                }
             fabric.call(
                 "on_checkpoint_coupled",
                 ckpt_path=os.path.join(log_dir, "checkpoint", f"ckpt_{policy_step}_{rank}.ckpt"),
@@ -609,8 +738,16 @@ def main(fabric: Any, cfg: Any) -> None:
     if envs is not None:
         envs.close()
     ckpt_mgr.finalize()
+    if use_population and fabric.is_global_zero:
+        # machine-readable member snapshot for the run_ci PBT drill and
+        # bench --mode population
+        write_population_summary(log_dir, pop_state, hp_state, policy_step)
     if fabric.is_global_zero and cfg.algo.run_test and not ckpt_mgr.preempted:
-        if use_anakin:
+        if use_population:
+            # eval the current BEST member (fitness argmax)
+            best = int(np.asarray(pop_state["fitness"]).argmax())
+            player_params = fabric.to_host(jax.tree.map(lambda x: x[best], params))
+        elif use_anakin:
             # the fused path never refreshes the host player copy — pull
             # the final params once for the eval episode
             player_params = fabric.to_host(params)

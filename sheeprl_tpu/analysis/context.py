@@ -44,6 +44,7 @@ METRIC_FAMILIES: Tuple[str, ...] = (
     "Dcn",         # cross-host pod transport (segments, broadcast, control)
     "Player",      # PlayerSync staleness
     "Telemetry",   # introspection endpoint self-metrics
+    "Population",  # in-trace PBT: fitness spread, exploits, hp quantiles
 )
 
 #: config subtrees whose LEAVES are data, not knobs — metric names as keys,

@@ -215,8 +215,10 @@ def run(argv: Optional[List[str]] = None) -> None:
 
     telemetry.HUB.reset()
     # a crashed loop never reached its sentinel teardown: drop the stale
-    # run-scoped Health/* source so it cannot leak into this run's flushes
+    # run-scoped Health/* and Population/* sources so they cannot leak
+    # into this run's flushes
     telemetry.HUB.unregister("health")
+    telemetry.HUB.unregister("population")
     telemetry.RECORDER.clear()
     cfg = compose(argv)
     # arm (or explicitly clear) the fault-injection plan before anything

@@ -11,6 +11,14 @@ the gym registration becomes an in-env ``truncated`` flag — inside a
 Reset draws all four state components from U(-0.05, 0.05) like gymnasium;
 the PRNG differs (threefry vs PCG64), so parity tests pin transitions from
 explicit states rather than comparing seeded reset draws.
+
+Difficulty axis (``env.level``, docs/jax_envs.md): ``level`` lives as a
+TRACED scalar in the state pytree — gravity scales by ``1 + 0.5·level``
+and the pole half-length by ``1 + level``, so a vmapped population can
+train members at different difficulties inside one executable.  At the
+default ``level=0`` every multiplier is exactly ``1.0`` (bit-exact in
+float32), so transitions stay bit-identical to the gymnasium-parity
+dynamics above.
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ class CartPoleState(NamedTuple):
     theta_dot: jax.Array  # pole angular velocity
     t: jax.Array  # step counter (int32)
     key: jax.Array  # per-instance PRNG stream
+    level: jax.Array = 0.0  # traced difficulty (gravity / pole length)
 
 
 class JaxCartPole(JaxEnv):
@@ -47,8 +56,9 @@ class JaxCartPole(JaxEnv):
     THETA_THRESHOLD = 12 * 2 * math.pi / 360
     X_THRESHOLD = 2.4
 
-    def __init__(self, max_episode_steps: int = 500):
+    def __init__(self, max_episode_steps: int = 500, level: float = 0.0):
         self.max_episode_steps = int(max_episode_steps)
+        self.level = float(level)
         high = np.array(
             [self.X_THRESHOLD * 2, np.inf, self.THETA_THRESHOLD * 2, np.inf],
             dtype=np.float32,
@@ -62,6 +72,7 @@ class JaxCartPole(JaxEnv):
         state = CartPoleState(
             x=init[0], x_dot=init[1], theta=init[2], theta_dot=init[3],
             t=jnp.zeros((), jnp.int32), key=k_carry,
+            level=jnp.full((), self.level, jnp.float32),
         )
         return state, self.observe(state)
 
@@ -73,15 +84,22 @@ class JaxCartPole(JaxEnv):
         }
 
     def step(self, state: CartPoleState, action: jax.Array):
+        # difficulty-derived constants, in-trace: ×(1.0) exactly at level=0,
+        # so the default level reproduces the gymnasium dynamics bit-for-bit
+        lvl = jnp.asarray(state.level, jnp.float32)
+        gravity = self.GRAVITY * (1.0 + 0.5 * lvl)
+        length = self.LENGTH * (1.0 + lvl)
+        polemass_length = self.MASSPOLE * length
         force = jnp.where(action.astype(jnp.int32) == 1, self.FORCE_MAG, -self.FORCE_MAG)
         costheta = jnp.cos(state.theta)
         sintheta = jnp.sin(state.theta)
-        # gymnasium's Euler step, verbatim
-        temp = (force + self.POLEMASS_LENGTH * state.theta_dot**2 * sintheta) / self.TOTAL_MASS
-        thetaacc = (self.GRAVITY * sintheta - costheta * temp) / (
-            self.LENGTH * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
+        # gymnasium's Euler step, verbatim (constants swapped for the traced
+        # level-derived ones above)
+        temp = (force + polemass_length * state.theta_dot**2 * sintheta) / self.TOTAL_MASS
+        thetaacc = (gravity * sintheta - costheta * temp) / (
+            length * (4.0 / 3.0 - self.MASSPOLE * costheta**2 / self.TOTAL_MASS)
         )
-        xacc = temp - self.POLEMASS_LENGTH * thetaacc * costheta / self.TOTAL_MASS
+        xacc = temp - polemass_length * thetaacc * costheta / self.TOTAL_MASS
         x = state.x + self.TAU * state.x_dot
         x_dot = state.x_dot + self.TAU * xacc
         theta = state.theta + self.TAU * state.theta_dot
@@ -92,7 +110,9 @@ class JaxCartPole(JaxEnv):
             (jnp.abs(x) > self.X_THRESHOLD) | (jnp.abs(theta) > self.THETA_THRESHOLD)
         )
         truncated = jnp.logical_and(t >= self.max_episode_steps, jnp.logical_not(terminated))
-        new_state = CartPoleState(x=x, x_dot=x_dot, theta=theta, theta_dot=theta_dot, t=t, key=state.key)
+        new_state = CartPoleState(
+            x=x, x_dot=x_dot, theta=theta, theta_dot=theta_dot, t=t, key=state.key, level=state.level
+        )
         return (
             new_state,
             self.observe(new_state),

@@ -94,6 +94,13 @@ class VectorJaxEnv:
         k_reset, k_carry = jax.random.split(s1.key)
         s1 = s1._replace(key=k_carry)
         s_reset, obs_reset = env.reset(k_reset)
+        if hasattr(s_reset, "level"):
+            # the difficulty level rides the CARRY, not the reset: a
+            # curriculum-overridden traced level (docs/population.md) must
+            # survive episode boundaries, and ``env.reset`` only knows the
+            # static default.  Bitwise no-op when nothing overrode it.
+            s_reset = s_reset._replace(level=s1.level)
+            obs_reset = env.observe(s_reset)
         s2 = jax.tree.map(lambda a, b: jnp.where(done, a, b), s_reset, s1)
         obs_out = jax.tree.map(lambda a, b: jnp.where(done, a, b), obs_reset, obs1)
         # obs1 is the TRUE final observation of the finished episode — the

@@ -14,6 +14,13 @@ The position and remaining food appear ONLY in the pixels (no state
 vector), so a policy can beat random exclusively through its CNN trunk —
 same design teeth as ``PixelGridDummyEnv``, but pure-JAX and procedurally
 seeded per episode.
+
+Difficulty axis (``env.level``, docs/jax_envs.md): the grid size is a
+STATIC array shape, so forage's level is resolved at construction — each
+whole level *doubles* the grid (while the image stays divisible), keeping
+the same ``n_food`` count on a larger board, i.e. a lower food density and
+a harder search problem.  ``level=0`` leaves the configured geometry
+untouched (bit-identical).
 """
 
 from __future__ import annotations
@@ -45,7 +52,16 @@ class JaxForage(JaxEnv):
         n_food: int = 6,
         image_hw: int = 64,
         max_episode_steps: int = 128,
+        level: float = 0.0,
     ):
+        self.level = float(level)
+        # static difficulty: each whole level doubles the grid (same food
+        # count on a bigger board = lower density) while the image stays an
+        # exact multiple of the cell size
+        grid = int(grid)
+        for _ in range(max(0, int(self.level))):
+            if grid * 2 <= image_hw and image_hw % (grid * 2) == 0:
+                grid *= 2
         if image_hw % grid != 0:
             raise ValueError(f"image_hw ({image_hw}) must be a multiple of grid ({grid})")
         if n_food >= grid * grid:

@@ -1,0 +1,223 @@
+"""Procedural multi-room pixel gridworld — doors, keys, food, one goal.
+
+A Crafter-lite for the pure-JAX suite: every episode procedurally generates
+a new layout **in-trace** from the instance's PRNG stream (no host-side
+level generator, no layout tables) — door rows, key cells, food scatter,
+agent start and goal are all drawn at ``reset`` and live in the state
+pytree.  The world is a ``grid × grid`` board split into rooms by vertical
+walls at fixed columns; each wall has one door, locked until the agent
+steps on that wall's key (placed somewhere left of the wall, so rooms are
+always solved in order and every episode is completable).  Food pellets
+pay +0.1, a key pickup +0.2, and reaching the goal cell in the last room
+pays +1.0 and **terminates** the episode; ``max_episode_steps`` truncates.
+
+Everything the agent needs is in the pixels (walls gray, closed doors red,
+open doors dark gray, keys yellow, food green, goal blue, agent white) —
+like :class:`~sheeprl_tpu.envs.jax.forage.JaxForage` this is a CNN-trunk
+exercise env, but with longer-horizon structure (unlock-progression).
+
+Difficulty axis (``env.level``, docs/jax_envs.md): ``level`` is a TRACED
+scalar in the state pytree selecting the active room count — ``1 +
+floor(level)`` walls (clamped to 3), i.e. 2 rooms at the default
+``level=0`` up to 4 rooms at ``level>=2``.  Inactive walls render (and
+collide) as open floor.  Because the room count is data, a vmapped
+population can train members across a difficulty curriculum inside ONE
+fused executable (docs/population.md).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from gymnasium import spaces
+
+from sheeprl_tpu.envs.jax.core import JaxEnv, Obs
+
+# noop/up/down/left/right — the forage action set
+_MOVES = np.array([[0, 0], [-1, 0], [1, 0], [0, -1], [0, 1]], dtype=np.int32)
+
+_WALL_RGB = np.array([128, 128, 128], np.uint8)
+_DOOR_RGB = np.array([200, 0, 0], np.uint8)  # locked
+_OPEN_RGB = np.array([60, 60, 60], np.uint8)  # unlocked passage
+_KEY_RGB = np.array([255, 255, 0], np.uint8)
+_FOOD_RGB = np.array([0, 255, 0], np.uint8)
+_GOAL_RGB = np.array([0, 0, 255], np.uint8)
+_AGENT_RGB = np.array([255, 255, 255], np.uint8)
+
+#: maximum wall count (4 rooms); walls sit at fixed fractions of the board
+_MAX_WALLS = 3
+
+
+class MultiRoomState(NamedTuple):
+    pos: jax.Array  # (2,) int32 agent cell (row, col)
+    door_row: jax.Array  # (3,) int32 door row per wall (procedural)
+    door_open: jax.Array  # (3,) bool unlocked doors
+    key_taken: jax.Array  # (3,) bool collected keys
+    key_pos: jax.Array  # (3, 2) int32 key cells (procedural)
+    food: jax.Array  # (grid, grid) bool remaining food
+    goal: jax.Array  # (2,) int32 goal cell (last column)
+    t: jax.Array  # step counter (int32)
+    key: jax.Array  # per-instance PRNG stream
+    level: jax.Array = 0.0  # traced difficulty (active room count)
+
+
+class JaxMultiRoom(JaxEnv):
+    def __init__(
+        self,
+        grid: int = 8,
+        n_food: int = 4,
+        image_hw: int = 64,
+        max_episode_steps: int = 256,
+        level: float = 0.0,
+    ):
+        grid = int(grid)
+        if grid < 8:
+            raise ValueError(f"grid ({grid}) must be >= 8 to fit 4 rooms")
+        if image_hw % grid != 0:
+            raise ValueError(f"image_hw ({image_hw}) must be a multiple of grid ({grid})")
+        self.grid = grid
+        self.n_food = int(n_food)
+        self.image_hw = int(image_hw)
+        self.cell = self.image_hw // self.grid
+        self.max_episode_steps = int(max_episode_steps)
+        self.level = float(level)
+        # fixed wall columns at quarter points: 2/4/6 on the default 8-grid
+        self.wall_cols = (grid // 4, grid // 2, (3 * grid) // 4)
+        self.observation_space = spaces.Dict(
+            {"rgb": spaces.Box(0, 255, (image_hw, image_hw, 3), np.uint8)}
+        )
+        self.action_space = spaces.Discrete(5)
+
+    # -- helpers -----------------------------------------------------------
+    def _n_walls(self, level: jax.Array) -> jax.Array:
+        """Active wall count from the traced level: 1 + floor(level), in
+        [1, 3] — two rooms at level 0, four at level >= 2."""
+        lvl = jnp.asarray(level, jnp.float32)
+        return 1 + jnp.clip(jnp.floor(lvl).astype(jnp.int32), 0, _MAX_WALLS - 1)
+
+    def _off_wall(self, cols: jax.Array) -> jax.Array:
+        """Shift procedural columns off the (even) wall columns: every wall
+        column minus one is a valid floor column."""
+        on_wall = jnp.zeros(cols.shape, bool)
+        for c in self.wall_cols:
+            on_wall = on_wall | (cols == c)
+        return jnp.where(on_wall, cols - 1, cols)
+
+    # -- contract ----------------------------------------------------------
+    def reset(self, key: jax.Array) -> Tuple[MultiRoomState, Obs]:
+        g = self.grid
+        k_door, k_start, k_goal, k_krow, k_kcol, k_frow, k_fcol, k_carry = jax.random.split(key, 8)
+        door_row = jax.random.randint(k_door, (_MAX_WALLS,), 0, g)
+        start_row = jax.random.randint(k_start, (), 0, g)
+        goal_row = jax.random.randint(k_goal, (), 0, g)
+        # key w lives strictly LEFT of wall w (rooms unlock in order; every
+        # layout is completable): draw col in [0, wall_col) and shift off
+        # any wall column (col-1 is always floor and still < wall_col)
+        key_row = jax.random.randint(k_krow, (_MAX_WALLS,), 0, g)
+        key_col = self._off_wall(
+            jax.random.randint(k_kcol, (_MAX_WALLS,), 0, jnp.asarray(self.wall_cols))
+        )
+        key_pos = jnp.stack([key_row, key_col], axis=1).astype(jnp.int32)
+        # food scatter anywhere on floor (overlaps with keys/goal are
+        # harmless: both payoffs trigger on the shared cell)
+        food_row = jax.random.randint(k_frow, (self.n_food,), 0, g)
+        food_col = self._off_wall(jax.random.randint(k_fcol, (self.n_food,), 0, g))
+        food = jnp.zeros((g, g), bool).at[food_row, food_col].set(True)
+        state = MultiRoomState(
+            pos=jnp.stack([start_row, jnp.zeros((), jnp.int32)]).astype(jnp.int32),
+            door_row=door_row.astype(jnp.int32),
+            door_open=jnp.zeros((_MAX_WALLS,), bool),
+            key_taken=jnp.zeros((_MAX_WALLS,), bool),
+            key_pos=key_pos,
+            food=food,
+            goal=jnp.stack([goal_row, jnp.full((), g - 1)]).astype(jnp.int32),
+            t=jnp.zeros((), jnp.int32),
+            key=k_carry,
+            level=jnp.full((), self.level, jnp.float32),
+        )
+        return state, self.observe(state)
+
+    def observe(self, state: MultiRoomState) -> Obs:
+        g = self.grid
+        n_walls = self._n_walls(state.level)
+        rows = jnp.arange(g)
+        cols = jnp.arange(g)
+        img = jnp.zeros((g, g, 3), jnp.uint8)
+        # walls + doors (active walls only; inactive walls are floor)
+        for w, c in enumerate(self.wall_cols):
+            active = w < n_walls
+            is_door = rows == state.door_row[w]
+            col_rgb = jnp.where(
+                is_door[:, None],
+                jnp.where(state.door_open[w], jnp.asarray(_OPEN_RGB), jnp.asarray(_DOOR_RGB)),
+                jnp.asarray(_WALL_RGB),
+            )
+            img = img.at[:, c, :].set(jnp.where(active, col_rgb, img[:, c, :]))
+        # food, then keys (untaken, active walls), then goal, agent on top
+        img = jnp.where(state.food[..., None], jnp.asarray(_FOOD_RGB), img)
+        for w in range(_MAX_WALLS):
+            kmask = (rows[:, None] == state.key_pos[w, 0]) & (cols[None, :] == state.key_pos[w, 1])
+            kmask = kmask & (w < n_walls) & ~state.key_taken[w]
+            img = jnp.where(kmask[..., None], jnp.asarray(_KEY_RGB), img)
+        gmask = (rows[:, None] == state.goal[0]) & (cols[None, :] == state.goal[1])
+        img = jnp.where(gmask[..., None], jnp.asarray(_GOAL_RGB), img)
+        amask = (rows[:, None] == state.pos[0]) & (cols[None, :] == state.pos[1])
+        img = jnp.where(amask[..., None], jnp.asarray(_AGENT_RGB), img)
+        img = jnp.repeat(jnp.repeat(img, self.cell, axis=0), self.cell, axis=1)
+        return {"rgb": img}
+
+    def step(self, state: MultiRoomState, action: jax.Array):
+        g = self.grid
+        n_walls = self._n_walls(state.level)
+        move = jnp.asarray(_MOVES)[action.astype(jnp.int32) % 5]
+        cand = jnp.clip(state.pos + move, 0, g - 1)
+        # collision: an active wall cell blocks unless it is that wall's
+        # door AND the door is open
+        blocked = jnp.zeros((), bool)
+        for w, c in enumerate(self.wall_cols):
+            at_wall = cand[1] == c
+            passable = (cand[0] == state.door_row[w]) & state.door_open[w]
+            blocked = blocked | ((w < n_walls) & at_wall & ~passable)
+        pos = jnp.where(blocked, state.pos, cand)
+
+        # key pickups unlock the matching door
+        reward = jnp.float32(0.0)
+        key_taken = state.key_taken
+        door_open = state.door_open
+        for w in range(_MAX_WALLS):
+            on_key = (
+                (pos[0] == state.key_pos[w, 0])
+                & (pos[1] == state.key_pos[w, 1])
+                & (w < n_walls)
+                & ~key_taken[w]
+            )
+            reward = reward + 0.2 * on_key.astype(jnp.float32)
+            key_taken = key_taken.at[w].set(key_taken[w] | on_key)
+            door_open = door_open.at[w].set(door_open[w] | on_key)
+
+        ate = state.food[pos[0], pos[1]]
+        food = state.food.at[pos[0], pos[1]].set(False)
+        reward = reward + 0.1 * ate.astype(jnp.float32)
+
+        at_goal = (pos[0] == state.goal[0]) & (pos[1] == state.goal[1])
+        reward = reward + at_goal.astype(jnp.float32)
+
+        t = state.t + 1
+        new_state = MultiRoomState(
+            pos=pos,
+            door_row=state.door_row,
+            door_open=door_open,
+            key_taken=key_taken,
+            key_pos=state.key_pos,
+            food=food,
+            goal=state.goal,
+            t=t,
+            key=state.key,
+            level=state.level,
+        )
+        terminated = at_goal
+        truncated = jnp.logical_and(t >= self.max_episode_steps, jnp.logical_not(terminated))
+        return new_state, self.observe(new_state), reward, terminated, truncated

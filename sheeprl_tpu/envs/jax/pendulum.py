@@ -4,6 +4,14 @@ Constants and dynamics follow gymnasium's ``classic_control/pendulum.py``
 (g=10.0 default, semi-implicit Euler with speed clipping, quadratic cost on
 normalized angle / speed / torque).  The 200-step TimeLimit becomes an
 in-env ``truncated`` flag; the env never terminates.
+
+Difficulty axis (``env.level``, docs/jax_envs.md): ``level`` is a TRACED
+scalar in the state pytree shrinking the effective torque limit to
+``MAX_TORQUE / (1 + level)`` — a weaker motor needs energy-pumping swings.
+The action space stays fixed at ``±MAX_TORQUE`` (spaces are static across
+levels); actions are clipped harder in-step.  ``level=0`` divides by
+exactly ``1.0``, keeping transitions bit-identical to the parity-tested
+dynamics.
 """
 
 from __future__ import annotations
@@ -28,6 +36,7 @@ class PendulumState(NamedTuple):
     theta_dot: jax.Array
     t: jax.Array  # step counter (int32)
     key: jax.Array  # per-instance PRNG stream
+    level: jax.Array = 0.0  # traced difficulty (torque limit)
 
 
 class JaxPendulum(JaxEnv):
@@ -38,9 +47,10 @@ class JaxPendulum(JaxEnv):
     M = 1.0
     L = 1.0
 
-    def __init__(self, max_episode_steps: int = 200, g: float = 10.0):
+    def __init__(self, max_episode_steps: int = 200, g: float = 10.0, level: float = 0.0):
         self.max_episode_steps = int(max_episode_steps)
         self.g = float(g)
+        self.level = float(level)
         high = np.array([1.0, 1.0, self.MAX_SPEED], dtype=np.float32)
         self.observation_space = spaces.Dict({"state": spaces.Box(-high, high, dtype=np.float32)})
         self.action_space = spaces.Box(-self.MAX_TORQUE, self.MAX_TORQUE, (1,), np.float32)
@@ -54,7 +64,8 @@ class JaxPendulum(JaxEnv):
             dtype=jnp.float32,
         )
         state = PendulumState(
-            theta=init[0], theta_dot=init[1], t=jnp.zeros((), jnp.int32), key=k_carry
+            theta=init[0], theta_dot=init[1], t=jnp.zeros((), jnp.int32), key=k_carry,
+            level=jnp.full((), self.level, jnp.float32),
         )
         return state, self.observe(state)
 
@@ -66,7 +77,9 @@ class JaxPendulum(JaxEnv):
         }
 
     def step(self, state: PendulumState, action: jax.Array):
-        u = jnp.clip(action.reshape(()), -self.MAX_TORQUE, self.MAX_TORQUE)
+        # traced torque limit: ÷(1.0) exactly at level=0 (bit-identical)
+        max_torque = self.MAX_TORQUE / (1.0 + jnp.asarray(state.level, jnp.float32))
+        u = jnp.clip(action.reshape(()), -max_torque, max_torque)
         th, thdot = state.theta, state.theta_dot
         costs = angle_normalize(th) ** 2 + 0.1 * thdot**2 + 0.001 * u**2
         newthdot = thdot + (
@@ -75,7 +88,9 @@ class JaxPendulum(JaxEnv):
         newthdot = jnp.clip(newthdot, -self.MAX_SPEED, self.MAX_SPEED)
         newth = th + newthdot * self.DT
         t = state.t + 1
-        new_state = PendulumState(theta=newth, theta_dot=newthdot, t=t, key=state.key)
+        new_state = PendulumState(
+            theta=newth, theta_dot=newthdot, t=t, key=state.key, level=state.level
+        )
         return (
             new_state,
             self.observe(new_state),

@@ -49,6 +49,13 @@ def _forage(**kwargs: Any) -> JaxEnv:
     return JaxForage(**kwargs)
 
 
+@_register("multiroom")
+def _multiroom(**kwargs: Any) -> JaxEnv:
+    from sheeprl_tpu.envs.jax.multiroom import JaxMultiRoom
+
+    return JaxMultiRoom(**kwargs)
+
+
 def make_jax_env(env_id: str, **kwargs: Any) -> JaxEnv:
     """Build a registered pure-JAX env; accepts both the bare registry name
     (``cartpole``) and the config-group spelling (``jax_cartpole``)."""
@@ -70,6 +77,10 @@ def jax_env_from_cfg(cfg: Any) -> JaxEnv:
     wrapper = dict(cfg.env.get("wrapper") or {})
     env_id = wrapper.pop("id", None) or cfg.env.id
     wrapper.pop("kind", None)
+    # difficulty axis (docs/jax_envs.md): a top-level env.level override
+    # reaches every jax env ctor without per-env wrapper plumbing
+    if cfg.env.get("level") is not None:
+        wrapper.setdefault("level", float(cfg.env.level))
     env = make_jax_env(env_id, **wrapper)
     if cfg.env.get("max_episode_steps"):
         env.max_episode_steps = int(cfg.env.max_episode_steps)

@@ -1,0 +1,23 @@
+"""In-trace population training on the Anakin axis (docs/population.md)."""
+
+from sheeprl_tpu.population.core import (
+    PBTConfig,
+    PopulationMonitor,
+    apply_level_curriculum,
+    init_population_state,
+    make_population_phase,
+    pbt_exploit_explore,
+    tile_stack,
+    write_population_summary,
+)
+
+__all__ = [
+    "PBTConfig",
+    "PopulationMonitor",
+    "apply_level_curriculum",
+    "init_population_state",
+    "make_population_phase",
+    "pbt_exploit_explore",
+    "tile_stack",
+    "write_population_summary",
+]

@@ -119,6 +119,10 @@ def _make_base_env(
         from sheeprl_tpu.envs.jax.registry import make_jax_env
 
         kwargs = {k: v for k, v in wrapper_cfg.items() if k not in ("kind", "id")}
+        # difficulty axis: the top-level env.level override reaches the
+        # adapter path too (same contract as registry.jax_env_from_cfg)
+        if cfg.env.get("level") is not None:
+            kwargs.setdefault("level", float(cfg.env.level))
         return JaxToGymAdapter(make_jax_env(wrapper_cfg.get("id") or env_id, **kwargs))
     raise ValueError(f"Unknown env wrapper kind '{kind}'")
 

@@ -12,24 +12,24 @@ cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
 export XLA_FLAGS="--xla_force_host_platform_device_count=8"
 
-echo "=== stage 1/17: unit + E2E dry-run suite (budget 1500s) ==="
+echo "=== stage 1/18: unit + E2E dry-run suite (budget 1500s) ==="
 timeout -k 15 1500 python -m pytest tests/ -x -q \
   --ignore=tests/test_regression --ignore=tests/test_checkpoint \
   --ignore=tests/test_resilience
 
-echo "=== stage 2/17: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) (budget 420s) ==="
+echo "=== stage 2/18: fault-tolerant checkpointing (commit protocol + SIGTERM/resume drill) (budget 420s) ==="
 timeout -k 15 420 python -m pytest tests/test_checkpoint -q
 
-echo "=== stage 3/17: chaos drills (fault injection: env storm, SIGKILL+quarantine resume, serve under faults) (budget 600s) ==="
+echo "=== stage 3/18: chaos drills (fault injection: env storm, SIGKILL+quarantine resume, serve under faults) (budget 600s) ==="
 timeout -k 15 600 python -m pytest tests/test_resilience -q
 
-echo "=== stage 4/17: numeric regression (goldens + reference fixture) (budget 600s) ==="
+echo "=== stage 4/18: numeric regression (goldens + reference fixture) (budget 600s) ==="
 timeout -k 15 600 python -m pytest tests/test_regression -q
 
-echo "=== stage 5/17: multichip dryrun (virtual 8-device mesh) (budget 900s) ==="
+echo "=== stage 5/18: multichip dryrun (virtual 8-device mesh) (budget 900s) ==="
 timeout -k 15 900 python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 
-echo "=== stage 6/17: 2-D (data x model) mesh training cell + compile budget (budget 600s) ==="
+echo "=== stage 6/18: 2-D (data x model) mesh training cell + compile budget (budget 600s) ==="
 # dreamer_v3 end-to-end through the CLI on a 2x4 fake-device mesh: the
 # partition-rules (TP) path with the recompile detector as a hard gate —
 # algo.max_recompiles=1 means each compile-once program (train phase, player
@@ -55,16 +55,16 @@ run([
     "checkpoint.every=0", "checkpoint.save_last=False", "buffer.memmap=False",
     "metric.log_level=0", "log_dir=/tmp/run_ci_tp_logs", "print_config=False",
 ])
-print("stage 6/17 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
+print("stage 6/18 OK: dreamer_v3 trained on a 2x4 data x model mesh within the compile budget")
 PY
 
-echo "=== stage 7/17: policy-serving smoke (HTTP server + batched requests + clean shutdown) (budget 600s) ==="
+echo "=== stage 7/18: policy-serving smoke (HTTP server + batched requests + clean shutdown) (budget 600s) ==="
 timeout -k 15 600 python tests/serve_smoke.py
 
-echo "=== stage 8/17: fault-injection zero-overhead gate (empty plan steady-state within 2%) (budget 600s) ==="
+echo "=== stage 8/18: fault-injection zero-overhead gate (empty plan steady-state within 2%) (budget 600s) ==="
 timeout -k 15 600 env BENCH_TARGET=fault_overhead python bench.py
 
-echo "=== stage 9/17: zero-copy device replay (dreamer_v3 + sac, transfer guard armed) (budget 900s) ==="
+echo "=== stage 9/18: zero-copy device replay (dreamer_v3 + sac, transfer guard armed) (budget 900s) ==="
 # Coupled dreamer_v3 and sac train SHORT real runs (not dryruns: the guard
 # only means something once steady-state windows exist) with the
 # device-resident replay forced on, jax.transfer_guard("disallow") armed
@@ -101,10 +101,10 @@ run([
     "algo.per_rank_batch_size=8",
     "log_dir=/tmp/run_ci_replay_sac",
 ] + common)
-print("stage 9/17 OK: dreamer_v3 + sac trained zero-copy under the transfer guard")
+print("stage 9/18 OK: dreamer_v3 + sac trained zero-copy under the transfer guard")
 PY
 
-echo "=== stage 10/17: scenario matrix (every algo x {cpu-gym, jax-env, dummy} x {coupled, decoupled}) (budget 1500s) ==="
+echo "=== stage 10/18: scenario matrix (every algo x {cpu-gym, jax-env, dummy} x {coupled, decoupled}) (budget 1500s) ==="
 # The enforced grid from ROADMAP item 5: each cell is an end-to-end dryrun
 # under algo.max_recompiles=1 (compile budget) and a per-cell wall budget
 # (tests/scenario_matrix.py prints the full coverage table, including the
@@ -112,7 +112,7 @@ echo "=== stage 10/17: scenario matrix (every algo x {cpu-gym, jax-env, dummy} x
 # on-policy loops: Anakin fused and the JaxToGymAdapter fallback.
 timeout -k 15 1500 python tests/scenario_matrix.py
 
-echo "=== stage 11/17: sebulba actor-learner topology (2-actor/2-learner fake-device split) (budget 600s) ==="
+echo "=== stage 11/18: sebulba actor-learner topology (2-actor/2-learner fake-device split) (budget 600s) ==="
 # ISSUE 12: decoupled PPO trains end-to-end through the Sebulba device
 # split — env-worker threads feeding batched AOT actor inference on the
 # actor group, the learner sub-mesh consuming the device-resident
@@ -135,17 +135,17 @@ run([
     "buffer.memmap=False", "metric.log_level=1", "metric.log_every=1",
     "print_config=False", "log_dir=/tmp/run_ci_sebulba",
 ])
-print("stage 11/17 OK: ppo_decoupled trained through the sebulba 2-actor/2-learner split within the compile budget")
+print("stage 11/18 OK: ppo_decoupled trained through the sebulba 2-actor/2-learner split within the compile budget")
 SEB
 
-echo "=== stage 12/17: telemetry drill (live /metrics + /v1/phase scrape, fault kill, postmortem evidence) (budget 600s) ==="
+echo "=== stage 12/18: telemetry drill (live /metrics + /v1/phase scrape, fault kill, postmortem evidence) (budget 600s) ==="
 # ISSUE 13: a short dv3 run with telemetry.introspect.port armed is scraped
 # MID-RUN (/metrics Prometheus exposition + /v1/phase breakdown summing to
 # ~1.0), then a planted env.step fault kills it and the run dir must hold a
 # well-formed postmortem.json containing the injected-fault event.
 timeout -k 15 600 python tests/telemetry_drill.py
 
-echo "=== stage 13/17: supervisor drill (fatal fault -> classified restart -> auto-resume -> full step count) (budget 600s) ==="
+echo "=== stage 13/18: supervisor drill (fatal fault -> classified restart -> auto-resume -> full step count) (budget 600s) ==="
 # ISSUE 14: a supervised SAC run is killed mid-run by a planted env.step
 # fault; the supervisor classifies the crash off postmortem.json, restarts
 # with checkpoint.resume_from=auto, and the resumed run completes with the
@@ -157,7 +157,7 @@ echo "=== stage 13/17: supervisor drill (fatal fault -> classified restart -> au
 timeout -k 15 600 python tests/supervisor_drill.py
 timeout -k 15 600 env BENCH_TARGET=health_overhead python bench.py
 
-echo "=== stage 14/17: graftlint static analysis (zero unsuppressed findings, strict baseline) (budget 120s) ==="
+echo "=== stage 14/18: graftlint static analysis (zero unsuppressed findings, strict baseline) (budget 120s) ==="
 # ISSUE 15: the JAX-law analyzer over the whole package — use-after-donate
 # (the PR 7/PR 14 bug class), trace purity, PRNG discipline, and the
 # config/fault-site/metric registries.  --strict also fails on STALE
@@ -165,7 +165,7 @@ echo "=== stage 14/17: graftlint static analysis (zero unsuppressed findings, st
 # Wall is additionally tracked by `bench.py --mode lint` (<60s gate).
 timeout -k 15 120 python -m sheeprl_tpu.analysis --strict
 
-echo "=== stage 15/17: pipelined world-model training cell (2-stage x 2-data mesh) (budget 600s) ==="
+echo "=== stage 15/18: pipelined world-model training cell (2-stage x 2-data mesh) (budget 600s) ==="
 # ISSUE 16: dreamer_v3 end-to-end through the CLI with the pipeline group
 # live — a pipeline mesh axis composing with the partition rules, the
 # world-model update running as the in-trace 1F1B microbatch schedule
@@ -192,10 +192,10 @@ run([
     "checkpoint.every=0", "checkpoint.save_last=False", "buffer.memmap=False",
     "metric.log_level=0", "log_dir=/tmp/run_ci_pipeline_logs", "print_config=False",
 ])
-print("stage 15/17 OK: dreamer_v3 trained 1F1B on a 2-stage x 2-data mesh within the compile budget")
+print("stage 15/18 OK: dreamer_v3 trained 1F1B on a 2-stage x 2-data mesh within the compile budget")
 PIPE
 
-echo "=== stage 16/17: serving-fleet chaos drill (kill -9 + injected faults + poisoned rollout -> zero drops) (budget 900s) ==="
+echo "=== stage 16/18: serving-fleet chaos drill (kill -9 + injected faults + poisoned rollout -> zero drops) (budget 900s) ==="
 # ISSUE 17: a REAL 2-replica fleet (LocalFleet subprocesses behind the
 # FleetRouter front) under concurrent session load takes injected
 # serve.replica faults AND a SIGKILL mid-stream — zero dropped requests,
@@ -204,7 +204,7 @@ echo "=== stage 16/17: serving-fleet chaos drill (kill -9 + injected faults + po
 # before ANY replica touches it, and a good commit must roll out to all.
 timeout -k 15 900 python tests/fleet_drill.py
 
-echo "=== stage 17/17: pod fault-tolerance drill (2-host fake DCN, SIGKILLed host -> collective restart -> full step count) (budget 900s) ==="
+echo "=== stage 17/18: pod fault-tolerance drill (2-host fake DCN, SIGKILLed host -> collective restart -> full step count) (budget 900s) ==="
 # ISSUE 19: a REAL 2-process pod (fake-DCN learner + actor cells, segments
 # and params crossing the process boundary over the learner front) is
 # supervised end to end: the actor "host" is SIGKILLed right after the
@@ -214,5 +214,14 @@ echo "=== stage 17/17: pod fault-tolerance drill (2-host fake DCN, SIGKILLed hos
 # checkpoint.resume_from=auto, and the resumed pod completes the FULL
 # step count from the newest shared commit, verifying clean for all ranks.
 timeout -k 15 900 python tests/pod_drill.py
+
+echo "=== stage 18/18: population drill (in-trace PBT beats fixed hyperparams at equal env steps) (budget 900s) ==="
+# ISSUE 20: two seeded population=4 CartPole PPO runs — whole population
+# vmapped inside ONE donated-carry fused executable (algo.max_recompiles=1)
+# — with in-trace exploit/explore armed vs population.exploit_every=0 (the
+# fixed-hyperparam control).  The PBT arm's best member must beat the
+# control arm's worst member on final fitness; anything else means the
+# selection machinery is dead weight.
+timeout -k 15 900 python tests/population_drill.py
 
 echo "CI gate: ALL GREEN"

@@ -167,6 +167,31 @@ def build_cells() -> List[Cell]:
             300.0,
         )
     )
+    # the procedural multi-room world (docs/jax_envs.md) in BOTH rollout
+    # modes; the anakin cell also pins the env.level difficulty override
+    # reaching the fused in-trace layout generator
+    cells.append(
+        (
+            "ppo×jax_multiroom×coupled-anakin-cnn",
+            ["exp=ppo", "env=jax_multiroom", "env.level=1.0",
+             "algo.rollout_steps=4", "algo.per_rank_batch_size=8",
+             "algo.update_epochs=1",
+             "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[]"],
+            "",
+            300.0,
+        )
+    )
+    cells.append(
+        (
+            "ppo×jax_multiroom×coupled-adapter",
+            ["exp=ppo", "env=jax_multiroom", "algo.anakin=False",
+             "algo.rollout_steps=4", "algo.per_rank_batch_size=8",
+             "algo.update_epochs=1",
+             "algo.cnn_keys.encoder=[rgb]", "algo.mlp_keys.encoder=[]"],
+            "",
+            300.0,
+        )
+    )
 
     # ---- off-policy (coupled) ----
     for fam in families:

@@ -1,0 +1,189 @@
+"""JaxMultiRoom — the procedural multi-room pixel gridworld (ISSUE 20).
+
+Pins the env's design claims: in-trace per-episode layout generation
+(reseeded on reset, completable by construction), the unlock-progression
+mechanics (key → door → next room → goal terminates), the pixel contract,
+and the traced room-count difficulty axis.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+from sheeprl_tpu.envs.jax.multiroom import _MAX_WALLS, JaxMultiRoom, MultiRoomState
+
+
+def _env(**kw):
+    return JaxMultiRoom(**kw)
+
+
+class TestLayoutGeneration:
+    def test_procedural_reset_reseeds_layout(self):
+        env = _env()
+        s1, _ = env.reset(jax.random.PRNGKey(0))
+        s2, _ = env.reset(jax.random.PRNGKey(1))
+        layout1 = np.concatenate(
+            [np.asarray(s1.door_row), np.asarray(s1.key_pos).ravel(), np.asarray(s1.food).ravel()]
+        )
+        layout2 = np.concatenate(
+            [np.asarray(s2.door_row), np.asarray(s2.key_pos).ravel(), np.asarray(s2.food).ravel()]
+        )
+        assert not np.array_equal(layout1, layout2)
+        # same seed → same layout (pure function of the key)
+        s1b, _ = env.reset(jax.random.PRNGKey(0))
+        np.testing.assert_array_equal(np.asarray(s1.door_row), np.asarray(s1b.door_row))
+        np.testing.assert_array_equal(np.asarray(s1.key_pos), np.asarray(s1b.key_pos))
+
+    def test_every_layout_is_completable(self):
+        # key w strictly LEFT of wall w and never on a wall column, so
+        # rooms always unlock in order
+        env = _env()
+        for seed in range(50):
+            s, _ = env.reset(jax.random.PRNGKey(seed))
+            key_col = np.asarray(s.key_pos)[:, 1]
+            for w, c in enumerate(env.wall_cols):
+                assert key_col[w] < c
+                assert key_col[w] not in env.wall_cols
+            # goal in the last column, agent starts in column 0
+            assert int(np.asarray(s.goal)[1]) == env.grid - 1
+            assert int(np.asarray(s.pos)[1]) == 0
+
+    def test_ctor_validation(self):
+        with pytest.raises(ValueError, match="grid"):
+            _env(grid=4)
+        with pytest.raises(ValueError, match="multiple"):
+            _env(grid=8, image_hw=60)
+
+
+class TestMechanics:
+    def _state(self, env, **overrides):
+        s, _ = env.reset(jax.random.PRNGKey(0))
+        return s._replace(**{k: jnp.asarray(v) for k, v in overrides.items()})
+
+    def test_wall_blocks_until_key_opens_door(self):
+        env = _env()
+        wall = env.wall_cols[0]
+        # agent just left of wall 0, in the door row, door closed
+        s = self._state(
+            env,
+            pos=np.array([3, wall - 1], np.int32),
+            door_row=np.array([2, 0, 0], np.int32),
+            door_open=np.zeros(_MAX_WALLS, bool),
+        )
+        s2, _, r, term, _ = env.step(s, jnp.asarray(4))  # right, into the wall
+        assert tuple(np.asarray(s2.pos)) == (3, wall - 1)  # blocked
+        assert float(r) == 0.0 and not bool(term)
+        # in the door row with the door open: passes through
+        s = self._state(
+            env,
+            pos=np.array([2, wall - 1], np.int32),
+            door_row=np.array([2, 0, 0], np.int32),
+            door_open=np.array([True, False, False]),
+        )
+        s2, _, _, _, _ = env.step(s, jnp.asarray(4))
+        assert tuple(np.asarray(s2.pos)) == (2, wall)
+
+    def test_key_pickup_pays_and_unlocks(self):
+        env = _env()
+        s = self._state(
+            env,
+            pos=np.array([5, 0], np.int32),
+            key_pos=np.array([[5, 1], [0, 3], [0, 5]], np.int32),
+            food=np.zeros((env.grid, env.grid), bool),
+        )
+        s2, _, r, _, _ = env.step(s, jnp.asarray(4))  # right onto key 0
+        assert float(r) == pytest.approx(0.2)
+        assert bool(np.asarray(s2.key_taken)[0]) and bool(np.asarray(s2.door_open)[0])
+        # second visit pays nothing (key gone)
+        s3 = s2._replace(pos=jnp.asarray(np.array([5, 0], np.int32)))
+        s4, _, r2, _, _ = env.step(s3, jnp.asarray(4))
+        assert float(r2) == 0.0
+
+    def test_food_pays_once(self):
+        env = _env()
+        food = np.zeros((env.grid, env.grid), bool)
+        food[6, 1] = True
+        s = self._state(env, pos=np.array([6, 0], np.int32), food=food)
+        s2, _, r, _, _ = env.step(s, jnp.asarray(4))
+        assert float(r) == pytest.approx(0.1)
+        assert not bool(np.asarray(s2.food)[6, 1])
+
+    def test_goal_pays_and_terminates(self):
+        env = _env()
+        s = self._state(
+            env,
+            pos=np.array([4, env.grid - 2], np.int32),
+            goal=np.array([4, env.grid - 1], np.int32),
+            food=np.zeros((env.grid, env.grid), bool),
+        )
+        _, _, r, term, trunc = env.step(s, jnp.asarray(4))
+        assert float(r) == pytest.approx(1.0)
+        assert bool(term) and not bool(trunc)
+
+    def test_truncates_at_step_limit(self):
+        env = _env(max_episode_steps=3)
+        s, _ = env.reset(jax.random.PRNGKey(2))
+        term = trunc = False
+        for _ in range(3):
+            s, _, _, term, trunc = env.step(s, jnp.asarray(0))  # noop
+        assert bool(trunc) and not bool(term)
+
+
+class TestPixelsAndLevel:
+    def test_pixel_contract(self):
+        env = _env()
+        _, obs = env.reset(jax.random.PRNGKey(0))
+        assert obs["rgb"].shape == (64, 64, 3) and obs["rgb"].dtype == jnp.uint8
+        img = np.asarray(obs["rgb"])
+        # agent (white) and goal (blue) visible; default level renders
+        # exactly ONE wall column (gray/red), the others are floor
+        assert (img == 255).all(axis=-1).any()
+        assert (img == np.array([0, 0, 255])).all(axis=-1).any()
+        cell = env.cell
+        wall_px = [c * cell for c in env.wall_cols]
+        col0 = img[:, wall_px[0], :]
+        assert ((col0 == 128).all(axis=-1) | (col0 == np.array([200, 0, 0])).all(axis=-1)).all()
+        assert (img[:, wall_px[1], :] == 0).all(axis=-1).sum() > 0  # inactive → floor
+
+    def test_level_activates_more_walls(self):
+        hard = _env(level=2.0)
+        s, obs = hard.reset(jax.random.PRNGKey(0))
+        assert int(hard._n_walls(s.level)) == 3
+        img = np.asarray(obs["rgb"])
+        cell = hard.cell
+        for c in hard.wall_cols:  # all three walls render solid
+            col = img[:, c * cell, :]
+            assert ((col == 128).all(axis=-1) | (col == np.array([200, 0, 0])).all(axis=-1)).all()
+
+    def test_level_rides_the_carry_through_autoreset(self):
+        # a curriculum-overridden traced level survives episode ends
+        venv = VectorJaxEnv(_env(max_episode_steps=4), 2)
+        state, _ = venv.reset(jax.random.PRNGKey(0))
+        state = state._replace(level=jnp.full((2,), 1.5, jnp.float32))
+        step = jax.jit(venv.step)
+        for _ in range(12):  # crosses 3 truncation boundaries
+            state, *_ = step(state, jnp.zeros((2,), jnp.int32))
+        np.testing.assert_array_equal(np.asarray(state.level), 1.5)
+
+    def test_fused_rollout_scan_traces(self):
+        # the whole env steps inside one jitted scan (the Anakin property)
+        venv = VectorJaxEnv(_env(), 4)
+
+        @jax.jit
+        def run(key):
+            state, obs = venv.reset(key)
+
+            def body(carry, k):
+                state = carry
+                a = jax.random.randint(k, (4,), 0, 5)
+                state, obs, r, term, trunc, _ = venv.step(state, a)
+                return state, r
+
+            _, rews = jax.lax.scan(body, state, jax.random.split(jax.random.PRNGKey(1), 32))
+            return rews
+
+        rews = run(jax.random.PRNGKey(0))
+        assert rews.shape == (32, 4)

@@ -1,0 +1,247 @@
+"""In-trace PBT math + the one-executable population contract
+(sheeprl_tpu/population/core.py, ISSUE 20 acceptance).
+
+* truncation selection is seeded-deterministic and copies params AND
+  opt-state through the SAME source index (a member never gets weights
+  from one donor and optimizer moments from another);
+* log-uniform perturbation stays inside the exploration bounds;
+* the exploit gate is a pure ``jnp.where`` select: off-cadence (or
+  pre-warmup) windows are bitwise no-ops, with NO second executable;
+* 50 fused population windows — rollout, member train, fitness EMA and
+  gated exploit/explore vmapped over the population — reuse ONE compiled
+  executable under the armed transfer guard (zero steady H2D).
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from sheeprl_tpu.envs.jax.cartpole import JaxCartPole
+from sheeprl_tpu.envs.jax.core import VectorJaxEnv
+from sheeprl_tpu.parallel.fabric import Fabric
+from sheeprl_tpu.population import (
+    PBTConfig,
+    init_population_state,
+    make_population_phase,
+    pbt_exploit_explore,
+    tile_stack,
+)
+from sheeprl_tpu.utils.structured import dotdict
+
+BASE = {"lr": 1e-3, "ent_coef": 0.01}
+
+
+def _pbt_cfg(**over):
+    pop = dict(
+        size=4, exploit_every=2, warmup=0, frac=0.25,
+        perturb_min=0.8, perturb_max=1.25, init_min=0.5, init_max=2.0,
+        bound_min=0.05, bound_max=20.0, fitness_alpha=0.3, levels=None,
+    )
+    pop.update(over)
+    return PBTConfig.from_cfg(dotdict({"population": pop}), base=dict(BASE))
+
+
+def _member_stacks(cfg):
+    # per-member-distinguishable params and a toy two-leaf opt state
+    size = cfg.size
+    params = {"w": jnp.arange(size * 3, dtype=jnp.float32).reshape(size, 3)}
+    opt_state = {
+        "mu": jnp.arange(size, dtype=jnp.float32) * 10.0,
+        "nu": jnp.arange(size, dtype=jnp.float32) * 100.0,
+    }
+    hp = cfg.init_hyperparams(jax.random.PRNGKey(11))
+    fitness = jnp.asarray([3.0, 0.5, 2.0, 1.0])  # member 1 is worst, 0 best
+    return params, opt_state, hp, fitness
+
+
+class TestExploitExplore:
+    def test_truncation_selection_is_seeded_deterministic(self):
+        cfg = _pbt_cfg()
+        params, opt_state, hp, fitness = _member_stacks(cfg)
+        do = jnp.asarray(True)
+        out1 = pbt_exploit_explore(params, opt_state, hp, fitness, do, jax.random.PRNGKey(5), cfg)
+        out2 = pbt_exploit_explore(params, opt_state, hp, fitness, do, jax.random.PRNGKey(5), cfg)
+        for a, b in zip(jax.tree.leaves(out1), jax.tree.leaves(out2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # a different key perturbs differently (the explore half is seeded)
+        out3 = pbt_exploit_explore(params, opt_state, hp, fitness, do, jax.random.PRNGKey(6), cfg)
+        assert any(
+            not np.array_equal(np.asarray(out1[2][k]), np.asarray(out3[2][k])) for k in hp
+        )
+
+    def test_exploit_copies_params_and_opt_state_together(self):
+        cfg = _pbt_cfg()
+        params, opt_state, hp, fitness = _member_stacks(cfg)
+        p2, o2, hp2, fit2, n_copied = pbt_exploit_explore(
+            params, opt_state, hp, fitness, jnp.asarray(True), jax.random.PRNGKey(0), cfg
+        )
+        assert int(n_copied) == cfg.n_select == 1
+        # worst member (1) received the best member's (0) weights AND both
+        # optimizer-moment leaves — a coherent (weights, moments) pair
+        np.testing.assert_array_equal(np.asarray(p2["w"][1]), np.asarray(params["w"][0]))
+        assert float(o2["mu"][1]) == float(opt_state["mu"][0])
+        assert float(o2["nu"][1]) == float(opt_state["nu"][0])
+        # the copied member inherits the source's fitness
+        assert float(fit2[1]) == float(fitness[0])
+        # untouched members keep their state bitwise
+        for m in (0, 2, 3):
+            np.testing.assert_array_equal(np.asarray(p2["w"][m]), np.asarray(params["w"][m]))
+            assert float(o2["mu"][m]) == float(opt_state["mu"][m])
+        # only the copied member's hyperparams were perturbed
+        for name in hp:
+            changed = np.asarray(hp2[name]) != np.asarray(hp[name])
+            assert changed[1] or BASE[name] == 0.0
+            assert not changed[[0, 2, 3]].any()
+
+    def test_perturbation_stays_within_bounds(self):
+        cfg = _pbt_cfg(size=8, frac=0.5, perturb_min=0.5, perturb_max=3.0, bound_min=0.5, bound_max=2.0)
+        params, opt_state, _, _ = {"w": jnp.zeros((8, 3))}, {"mu": jnp.zeros((8,))}, None, None
+        hp = cfg.init_hyperparams(jax.random.PRNGKey(1))
+        fitness = jnp.arange(8.0)
+        for seed in range(5):
+            _, _, hp, fitness, _ = pbt_exploit_explore(
+                params, opt_state, hp, fitness, jnp.asarray(True), jax.random.PRNGKey(seed), cfg
+            )
+            for name, base in BASE.items():
+                v = np.asarray(hp[name])
+                assert (v >= base * cfg.bound_min - 1e-12).all()
+                assert (v <= base * cfg.bound_max + 1e-12).all()
+
+    def test_closed_gate_is_bitwise_noop(self):
+        cfg = _pbt_cfg()
+        params, opt_state, hp, fitness = _member_stacks(cfg)
+        p2, o2, hp2, fit2, n_copied = pbt_exploit_explore(
+            params, opt_state, hp, fitness, jnp.asarray(False), jax.random.PRNGKey(0), cfg
+        )
+        assert int(n_copied) == 0
+        np.testing.assert_array_equal(np.asarray(p2["w"]), np.asarray(params["w"]))
+        np.testing.assert_array_equal(np.asarray(o2["mu"]), np.asarray(opt_state["mu"]))
+        np.testing.assert_array_equal(np.asarray(fit2), np.asarray(fitness))
+        for name in hp:
+            np.testing.assert_array_equal(np.asarray(hp2[name]), np.asarray(hp[name]))
+
+
+class TestPopulationPhaseGating:
+    def _toy_phase(self, cfg):
+        # members report fitness proportional to their lr, so selection
+        # ordering is known without running a real env
+        def member_phase(p, o_state, actor, k, hp):
+            stats = {
+                "ep_done": jnp.ones((2, 3), bool),
+                "ep_ret": jnp.ones((2, 3)) * hp["lr"] * 1e3,
+                "ep_len": jnp.ones((2, 3), jnp.int32),
+            }
+            actor = {**actor, "update": actor["update"] + 1}
+            return p, o_state, actor, (jnp.zeros(()),), stats
+
+        return make_population_phase(member_phase, cfg)
+
+    def test_no_exploit_below_warmup_or_off_cadence(self):
+        cfg = _pbt_cfg(exploit_every=2, warmup=5)
+        phase = jax.jit(self._toy_phase(cfg))
+        params = tile_stack({"w": jnp.zeros((3,))}, cfg.size)
+        opt_state = tile_stack({"mu": jnp.zeros(())}, cfg.size)
+        members = {"update": jnp.zeros((cfg.size,), jnp.int32)}
+        pop = init_population_state(members, cfg, num_envs=3)
+        hp = cfg.init_hyperparams(jax.random.PRNGKey(2))
+        hp0 = {k: np.asarray(v) for k, v in hp.items()}
+        key = jax.random.PRNGKey(0)
+        exploit_updates = []
+        for update in range(1, 9):
+            params, opt_state, pop, hp, key, _, _ = phase(params, opt_state, pop, hp, key)
+            if int(pop["exploits"]) > len(exploit_updates) * cfg.n_select:
+                exploit_updates.append(update)
+        # cadence 2, warmup 5 → exploit fires at updates 6 and 8 only
+        assert exploit_updates == [6, 8]
+        # fitness tracked the lr ordering, so the lowest-lr member copied up
+        assert int(pop["exploits"]) == 2 * cfg.n_select
+        worst = int(np.asarray(hp0["lr"]).argmin())
+        assert float(np.asarray(hp["lr"])[worst]) != float(hp0["lr"][worst])
+
+
+class TestOneExecutablePopulation:
+    def test_cache_size_one_across_50_windows_guarded(self):
+        from sheeprl_tpu.algos.ppo.agent import sample_actions
+        from sheeprl_tpu.envs.jax.anakin import make_rollout_fn
+
+        cfg = _pbt_cfg(size=3, exploit_every=3, warmup=2)
+        fabric = Fabric(devices=1, accelerator="cpu")
+        venv = VectorJaxEnv(JaxCartPole(), 2)
+
+        def apply(p, obs):
+            h = obs["state"] @ p["w"]
+            return h[:, :2], h[:, 2:3]
+
+        rollout_fn = make_rollout_fn(
+            venv, apply, lambda out, k: sample_actions(out, (2,), False, k),
+            cnn_keys=(), mlp_keys=("state",),
+            action_space=venv.single_action_space,
+            gamma=0.99, rollout_steps=4,
+        )
+
+        def member_phase(p, o_state, actor, k, hp):
+            actor, rollout, last_obs, stats = rollout_fn(p, actor, k)
+            # stand-in train: params/opt-state depend on the rollout AND the
+            # member's traced hyperparameters
+            delta = jnp.mean(rollout["state"]) + jnp.mean(rollout["rewards"])
+            p = {"w": p["w"] + 0.0 * delta * hp["lr"]}
+            o_state = {"mu": o_state["mu"] * 0.9 + hp["lr"] + 0.0 * hp["ent_coef"]}
+            return p, o_state, actor, (jnp.zeros(()),), stats
+
+        population_step = fabric.compile(
+            make_population_phase(member_phase, cfg),
+            name="test.population_phase",
+            donate_argnums=(0, 1, 2, 3),
+        )
+
+        def _init_member(k):
+            env_state, _ = venv.reset(k)
+            return {
+                "env": env_state,
+                "ep_ret": jnp.zeros((2,), jnp.float32),
+                "ep_len": jnp.zeros((2,), jnp.int32),
+            }
+
+        members = jax.vmap(_init_member)(jax.random.split(jax.random.PRNGKey(0), cfg.size))
+        members["update"] = jnp.zeros((cfg.size,), jnp.int32)
+        pop = init_population_state(members, cfg, num_envs=2)
+        params = tile_stack({"w": jnp.zeros((4, 3), jnp.float32)}, cfg.size)
+        opt_state = tile_stack({"mu": jnp.zeros(())}, cfg.size)
+        hp = cfg.init_hyperparams(jax.random.PRNGKey(3))
+        key = jax.random.PRNGKey(1)
+        for i in range(50):
+            # steady state (every window after the first) runs under the
+            # armed guard: ANY implicit H2D — including from the gated
+            # exploit windows at updates 3·k — dies here
+            guard = (
+                jax.transfer_guard_host_to_device("disallow")
+                if i > 0
+                else contextlib.nullcontext()
+            )
+            with guard:
+                params, opt_state, pop, hp, key, losses, stats = population_step(
+                    params, opt_state, pop, hp, key
+                )
+        assert population_step.cache_size() == 1
+        np.testing.assert_array_equal(np.asarray(pop["members"]["update"]), 50)
+        # the PBT gate opened on cadence inside the ONE executable
+        assert int(pop["exploits"]) == cfg.n_select * len([u for u in range(1, 51) if u > 2 and u % 3 == 0])
+
+
+class TestConfigValidation:
+    def test_rejects_degenerate_populations(self):
+        with pytest.raises(ValueError, match="size"):
+            _pbt_cfg(size=1)
+        with pytest.raises(ValueError, match="frac"):
+            _pbt_cfg(frac=0.9)
+        with pytest.raises(ValueError, match="perturb"):
+            _pbt_cfg(perturb_min=1.5, perturb_max=1.2)
+
+    def test_n_select_clamps_to_half(self):
+        assert _pbt_cfg(size=4, frac=0.25).n_select == 1
+        assert _pbt_cfg(size=8, frac=0.5).n_select == 4
+        assert _pbt_cfg(size=2, frac=0.5).n_select == 1
